@@ -1,0 +1,62 @@
+"""superlu_dist_tpu — a TPU-native distributed sparse direct solver.
+
+A brand-new JAX/XLA/Pallas implementation with the capabilities of
+SuperLU_DIST (reference: /root/reference, v8.1.1): sparse LU with static
+pivoting (GESP), supernodal numeric factorization over a 2D/3D device
+mesh, block-sparse triangular solves, iterative refinement, and a
+mixed-precision (low-precision factor + f64 residual) mode.
+
+Design (see SURVEY.md §7): static pivoting makes the numeric phase a
+fixed DAG of dense block operations with static shapes — exactly what
+XLA wants.  The factorization is formulated multifrontally: each
+supernode owns a dense frontal matrix, fronts are padded to a small set
+of bucket shapes and batched per elimination-tree level, so the hot loop
+is pure batched GEMM/TRSM on the MXU.  Distribution is level-synchronous
+sharding over a `jax.sharding.Mesh` with ancestor reductions as `psum`
+(the TPU-native analog of the reference's 3D communication-avoiding
+algorithm, SRC/pdgstrf3d.c).
+
+Double precision is first-class for a linear solver, so importing this
+package enables JAX x64 mode.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .options import (  # noqa: E402
+    ColPerm,
+    Fact,
+    IterRefine,
+    Options,
+    RowPerm,
+    Trans,
+    YesNo,
+)
+from .utils.stats import Stats  # noqa: E402
+from .sparse import CSRMatrix, csr_from_coo, csr_from_scipy  # noqa: E402
+from .plan.plan import FactorPlan, plan_factorization  # noqa: E402
+from .models.gssvx import LUFactorization, factorize, gssvx, solve  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ColPerm",
+    "Fact",
+    "IterRefine",
+    "Options",
+    "RowPerm",
+    "Trans",
+    "YesNo",
+    "Stats",
+    "CSRMatrix",
+    "csr_from_coo",
+    "csr_from_scipy",
+    "FactorPlan",
+    "plan_factorization",
+    "LUFactorization",
+    "factorize",
+    "gssvx",
+    "solve",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+from .gssvx import LUFactorization, factorize, gssvx, solve
+
+__all__ = ["LUFactorization", "factorize", "gssvx", "solve"]

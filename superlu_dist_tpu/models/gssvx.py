@@ -1,0 +1,193 @@
+"""Expert driver: the pdgssvx analog (SRC/pdgssvx.c:506).
+
+`gssvx(options, A, B)` runs the full pipeline — equilibrate, static
+pivoting row perm, fill-reducing col perm, symbolic plan, numeric
+factorization, triangular solves, iterative refinement — and returns X
+plus statistics.  `factorize`/`solve` expose the two halves for the
+Fact reuse ladder (SamePattern / SamePattern_SameRowPerm / FACTORED,
+SRC/superlu_defs.h:577-598):
+
+    plan = plan_factorization(A, opts)        # once per pattern
+    lu   = factorize(A, plan=plan)            # per value set
+    x    = solve(lu, b)                       # per right-hand side
+
+Backends: "jax" (bucketed level-batched device execution, the TPU path)
+and "host" (numpy reference multifrontal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..options import ColPerm, Fact, IterRefine, Options, Trans
+from ..plan.plan import FactorPlan, plan_factorization
+from ..sparse import CSRMatrix
+from ..utils.stats import Stats
+from ..ops import ref_multifrontal
+
+
+@dataclasses.dataclass
+class LUFactorization:
+    """Factorization handle: plan + numeric factors (LUstruct analog,
+    SRC/superlu_ddefs.h:266-271)."""
+    plan: FactorPlan
+    backend: str
+    host_lu: Optional[object] = None      # ops.ref_multifrontal.HostLU
+    device_lu: Optional[object] = None    # ops.batched.DeviceLU
+    a: Optional[CSRMatrix] = None         # kept for refinement residuals
+    stats: Optional[Stats] = None
+    options: Optional[Options] = None     # effective numeric options
+    # cached refinement operands (rebuilt per factorization, reused
+    # across the many solves the FACTORED rung is for)
+    refine_cache: Optional[dict] = None
+
+    @property
+    def n(self) -> int:
+        return self.plan.n
+
+    @property
+    def effective_options(self) -> Options:
+        return self.options or self.plan.options
+
+
+def factorize(a: CSRMatrix, options: Options | None = None,
+              plan: FactorPlan | None = None,
+              stats: Stats | None = None,
+              backend: str = "auto",
+              user_perm_r: np.ndarray | None = None,
+              user_perm_c: np.ndarray | None = None) -> LUFactorization:
+    # caller's options win (numeric knobs may differ from the cached
+    # plan's); fall back to the plan's when none are given
+    if options is None:
+        options = plan.options if plan is not None else Options()
+    stats = stats if stats is not None else Stats()
+    if plan is None:
+        plan = plan_factorization(a, options, stats=stats,
+                                  user_perm_r=user_perm_r,
+                                  user_perm_c=user_perm_c)
+    scaled = plan.scaled_values(a)
+    if backend == "auto":
+        try:
+            from ..ops import batched  # noqa: F401
+            backend = "jax"
+        except ImportError:
+            backend = "host"
+
+    with stats.timer("FACT"):
+        if backend == "host":
+            host_lu = ref_multifrontal.factorize_host(
+                plan, scaled, dtype=np.dtype(options.factor_dtype))
+            stats.tiny_pivots += host_lu.tiny_pivots
+            lu = LUFactorization(plan=plan, backend="host",
+                                 host_lu=host_lu, a=a, stats=stats)
+        elif backend == "jax":
+            from ..ops import batched
+            device_lu = batched.factorize_device(
+                plan, scaled, dtype=np.dtype(options.factor_dtype))
+            stats.tiny_pivots += int(device_lu.tiny_pivots)
+            lu = LUFactorization(plan=plan, backend="jax",
+                                 device_lu=device_lu, a=a, stats=stats)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+    lu.options = options
+    stats.add_ops("FACT", plan.factor_flops)
+    return lu
+
+
+def _solve_factored(lu: LUFactorization, b_factor_order: np.ndarray):
+    """Triangular solves in factor ordering/scaling."""
+    if lu.backend == "host":
+        return ref_multifrontal.solve_host(lu.host_lu, b_factor_order)
+    from ..ops import batched
+    return batched.solve_device(lu.device_lu, b_factor_order)
+
+
+def solve(lu: LUFactorization, b: np.ndarray,
+          stats: Stats | None = None) -> np.ndarray:
+    """Solve A·x = b for one or many right-hand sides (b: (n,) or
+    (n, nrhs)).  Applies scalings/permutations, the factored solves,
+    and iterative refinement per options (pdgstrs + pdgsrfs analog,
+    SRC/pdgstrs.c:1035, SRC/pdgsrfs.c:124)."""
+    plan = lu.plan
+    stats = stats or lu.stats or Stats()
+    options = lu.effective_options
+    if options.trans != Trans.NOTRANS:
+        # transpose solve (pdgssvx trans contract) lands with the
+        # dedicated Aᵀ sweep; fail loudly instead of silently solving
+        # the NOTRANS system.
+        raise NotImplementedError(
+            "Trans.TRANS/CONJ solves are not implemented yet")
+    b = np.asarray(b)
+    if b.shape[0] != plan.n:
+        raise ValueError(
+            f"b has {b.shape[0]} rows but the matrix is {plan.n}×{plan.n}")
+    squeeze = b.ndim == 1
+    bb = b[:, None] if squeeze else b
+
+    # b' = Pfinal · Dr · b ; x = Dc · Pfinalᵀ · y
+    def to_factor_rhs(v):
+        scaled = v * plan.row_scale[:, None]
+        out = np.empty_like(scaled)
+        out[plan.final_row] = scaled
+        return out
+
+    def from_factor_sol(y):
+        out = y[plan.final_col]
+        return out * plan.col_scale[:, None]
+
+    with stats.timer("SOLVE"):
+        x = from_factor_sol(_solve_factored(lu, to_factor_rhs(bb)))
+
+    if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
+        from .refine import iterative_refine
+        with stats.timer("REFINE"):
+            x, berr, steps = iterative_refine(
+                lu, bb, x, _solve_factored, to_factor_rhs, from_factor_sol)
+        stats.berr = berr
+        stats.refine_steps += steps
+
+    return x[:, 0] if squeeze else x
+
+
+def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
+          stats: Stats | None = None, backend: str = "auto",
+          lu: LUFactorization | None = None,
+          user_perm_r: np.ndarray | None = None,
+          user_perm_c: np.ndarray | None = None):
+    """One-call driver.  Returns (x, lu, stats).  Pass `lu` with
+    options.fact=FACTORED to reuse a prior factorization, or with
+    options.fact=SAME_PATTERN* to re-factor new values reusing the
+    plan.  user_perm_r/user_perm_c feed RowPerm.MY_PERMR /
+    ColPerm.MY_PERMC."""
+    options = options or Options()
+    stats = stats if stats is not None else Stats()
+    if options.fact in (Fact.FACTORED, Fact.SAME_PATTERN,
+                        Fact.SAME_PATTERN_SAME_ROWPERM) and lu is None:
+        raise ValueError(f"options.fact={options.fact.name} requires "
+                         "an existing lu")
+    if options.fact == Fact.FACTORED:
+        pass
+    elif (lu is not None and options.fact == Fact.SAME_PATTERN):
+        # reuse only the fill-reducing column permutation (the
+        # expensive ordering); recompute equilibration, row perm and
+        # the symbolic plan for the new values — the reference's
+        # SamePattern semantics (perm_c + etree reuse,
+        # SRC/superlu_defs.h:584-588)
+        opts2 = options.replace(col_perm=ColPerm.MY_PERMC)
+        plan = plan_factorization(a, opts2, stats=stats,
+                                  user_perm_c=lu.plan.perm_c)
+        lu = factorize(a, opts2, plan=plan, stats=stats, backend=backend)
+    elif (lu is not None
+          and options.fact == Fact.SAME_PATTERN_SAME_ROWPERM):
+        # reuse perms, scalings and the whole symbolic plan; refresh
+        # numeric values only
+        lu = factorize(a, options, plan=lu.plan, stats=stats,
+                       backend=backend)
+    else:
+        lu = factorize(a, options, stats=stats, backend=backend,
+                       user_perm_r=user_perm_r, user_perm_c=user_perm_c)
+    x = solve(lu, b, stats=stats)
+    return x, lu, stats

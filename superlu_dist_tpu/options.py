@@ -1,0 +1,131 @@
+"""Solver options.
+
+TPU-native analog of the reference's option system:
+`superlu_dist_options_t` (SRC/superlu_defs.h:716-755), the enum constants
+(SRC/superlu_enum_consts.h:29-90) and `set_default_options_dist`
+(SRC/util.c:203-238).  One dataclass with typed enums replaces the C
+struct + int-coded constants; defaults mirror the reference's where they
+make sense on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+
+
+class YesNo(enum.Enum):
+    NO = 0
+    YES = 1
+
+    def __bool__(self) -> bool:
+        return self is YesNo.YES
+
+
+class Fact(enum.Enum):
+    """Factorization reuse ladder (SRC/superlu_defs.h:577-598).
+
+    The reference's checkpoint/resume analog (SURVEY.md §5.4): PDE apps
+    re-solve with the same sparsity pattern (or the same pattern *and*
+    row permutation) many times; each rung reuses more of the cached
+    plan/factorization.
+    """
+
+    DOFACT = 0                  # factor from scratch
+    SAME_PATTERN = 1            # reuse col perm + etree + symbolic plan
+    SAME_PATTERN_SAME_ROWPERM = 2  # also reuse row perm + scalings
+    FACTORED = 3                # reuse the numeric factorization; just solve
+
+
+class RowPerm(enum.Enum):
+    """Static-pivoting row permutation (SRC/superlu_enum_consts.h:32)."""
+
+    NOROWPERM = 0
+    LARGE_DIAG_MC64 = 1     # serial max-product bipartite matching (MC64 job=5)
+    LARGE_DIAG_HWPM = 2     # parallel heavy-weight perfect matching analog
+    MY_PERMR = 3            # user-supplied perm_r
+
+
+class ColPerm(enum.Enum):
+    """Fill-reducing column permutation (SRC/superlu_enum_consts.h:33-41)."""
+
+    NATURAL = 0
+    MMD_ATA = 1             # minimum degree on A^T A
+    MMD_AT_PLUS_A = 2       # minimum degree on A^T + A
+    COLAMD = 3
+    METIS_AT_PLUS_A = 4     # nested dissection on A^T + A
+    PARMETIS = 5
+    MY_PERMC = 6            # user-supplied perm_c
+    RCM = 7                 # reverse Cuthill-McKee (TPU-build extra)
+    AMD = 8                 # approximate minimum degree (TPU-build native)
+
+
+class IterRefine(enum.Enum):
+    """Iterative refinement mode (SRC/superlu_enum_consts.h:34)."""
+
+    NOREFINE = 0
+    SLU_SINGLE = 1          # residual accumulated in working precision
+    SLU_DOUBLE = 2          # residual accumulated in f64 (psgsrfs_d2 analog)
+
+
+class Trans(enum.Enum):
+    NOTRANS = 0
+    TRANS = 1
+    CONJ = 2
+
+
+def _env_int(name: str, default: int) -> int:
+    """Env-var override, mirroring sp_ienv_dist's SUPERLU_* chain
+    (SRC/sp_ienv.c:60-146)."""
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+@dataclasses.dataclass
+class Options:
+    """All solver knobs; defaults follow set_default_options_dist
+    (SRC/util.c:203-238) adapted to TPU.
+    """
+
+    fact: Fact = Fact.DOFACT
+    equil: YesNo = YesNo.YES
+    row_perm: RowPerm = RowPerm.LARGE_DIAG_MC64
+    col_perm: ColPerm = ColPerm.MMD_AT_PLUS_A
+    replace_tiny_pivot: YesNo = YesNo.YES
+    iter_refine: IterRefine = IterRefine.SLU_DOUBLE
+    trans: Trans = Trans.NOTRANS
+    solve_initialized: YesNo = YesNo.NO
+    refact_initialized: YesNo = YesNo.NO
+    print_stat: YesNo = YesNo.NO
+
+    # --- supernode / scheduling tunables (sp_ienv_dist analogs) ---
+    # sp_ienv(2): relaxed-supernode max size (SRC/sp_ienv.c, SUPERLU_RELAX)
+    relax: int = dataclasses.field(default_factory=lambda: _env_int("SUPERLU_RELAX", 32))
+    # sp_ienv(3): maximum supernode width (SUPERLU_MAXSUP; MAX_SUPER_SIZE=512)
+    max_super: int = dataclasses.field(default_factory=lambda: _env_int("SUPERLU_MAXSUP", 128))
+    # look-ahead window depth (num_lookaheads=10 in the reference; on TPU
+    # this controls cross-level pipelining of panel collectives)
+    num_lookaheads: int = 10
+
+    # --- precision strategy (the psgssvx_d2 mixed mode, SRC/psgssvx_d2.c:516,
+    # generalized: factor in `factor_dtype`, accumulate residuals in
+    # `refine_dtype`) ---
+    factor_dtype: str = "float64"
+    refine_dtype: str = "float64"
+
+    # --- iterative refinement controls ---
+    max_refine_steps: int = 8
+
+    # --- TPU bucketing (replaces ragged supernode shapes; SURVEY.md §7) ---
+    width_buckets: tuple = (8, 16, 32, 64, 128, 256, 512)
+    front_buckets: tuple = (16, 32, 64, 128, 256, 384, 512, 768, 1024,
+                            1536, 2048, 3072, 4096, 6144, 8192)
+
+    # --- distribution ---
+    # 3D-algorithm analog: number of forest levels replicated over the
+    # mesh's Z axis (options->Algo3d, SRC/superlu_defs.h:754)
+    algo3d: YesNo = YesNo.NO
+
+    def replace(self, **kw) -> "Options":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,3 @@
+from .plan import FactorPlan, plan_factorization
+
+__all__ = ["FactorPlan", "plan_factorization"]

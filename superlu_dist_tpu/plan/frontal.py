@@ -1,0 +1,154 @@
+"""Multifrontal execution plan: per-supernode index maps + buckets.
+
+This is the TPU-native replacement for the reference's distributed LU
+metadata (dLocalLU_t index arrays, SRC/superlu_ddefs.h:97-263, and the
+static schedule SRC/dstatic_schedule.c).  Each supernode s owns a dense
+*frontal matrix* over the index set I_s = cols(s) ∪ struct(s); the
+numeric factorization is then a fixed DAG of dense block ops:
+
+    assemble (scatter A entries + extend-add child updates)
+    → partial LU of the leading w×w block  (panel factor, MXU)
+    → TRSM L21/U12                          (MXU)
+    → Schur update C = A22 − L21·U12        (MXU GEMM)
+    → pass C to the parent front (extend-add)
+
+Ragged sizes are padded to bucket shapes (wb, mb) so batched jitted
+kernels never retrace (SURVEY.md §7 "padding-to-buckets"; the
+reference's analog constraint is maxsup ≤ MAX_SUPER_SIZE=512,
+SRC/superlu_defs.h:139).  Padding in the pivot block carries an
+identity diagonal so the padded partial LU equals the unpadded one.
+
+All maps here are host-side numpy, computed once per sparsity pattern
+and cached in the FactorPlan (the SamePattern reuse rung,
+SRC/superlu_defs.h:577-598).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .symbolic import SymbolicFactorization
+
+
+def bucketize(values: np.ndarray, buckets: tuple) -> np.ndarray:
+    """Smallest bucket ≥ value.  The bucket ladder is extended
+    geometrically (×1.5, rounded up to 256) past its configured top so
+    arbitrarily large separator fronts (e.g. audikw_1-scale) plan
+    rather than error."""
+    b = list(buckets)
+    vmax = int(values.max()) if len(values) else 0
+    while b[-1] < vmax:
+        b.append(int(-(-int(b[-1] * 1.5) // 256) * 256))
+    b = np.asarray(b, dtype=np.int64)
+    idx = np.searchsorted(b, values, side="left")
+    return b[idx]
+
+
+@dataclasses.dataclass
+class FrontalPlan:
+    sym: SymbolicFactorization
+    n: int
+    # per-supernode geometry
+    w: np.ndarray        # supernode widths
+    r: np.ndarray        # off-block rows
+    m: np.ndarray        # w + r (true front size)
+    wb: np.ndarray       # padded pivot-block width
+    mb: np.ndarray       # padded front size
+    I: List[np.ndarray]  # global index set per supernode (sorted)
+    # A-value assembly, grouped per supernode: indices into the COO
+    # value array of the (scaled, unpermuted-order) input matrix, and
+    # destination (row, col) local positions in the *unpadded* front
+    a_src: List[np.ndarray]
+    a_lr: List[np.ndarray]
+    a_lc: List[np.ndarray]
+    # extend-add: child struct positions within parent's I (length r[s])
+    ea_map: List[np.ndarray]
+    # level schedule over the supernodal etree
+    level_supernodes: List[np.ndarray]
+    # flop estimate of the true (unpadded) factorization
+    factor_flops: float
+
+    @property
+    def nsuper(self) -> int:
+        return self.sym.nsuper
+
+
+def _local_positions(I_s: np.ndarray, first: int, last: int,
+                     struct_s: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Position of each global index in I_s = [first..last] ∪ struct_s."""
+    w = last - first + 1
+    inblock = idx <= last
+    pos = np.empty(len(idx), dtype=np.int64)
+    pos[inblock] = idx[inblock] - first
+    if np.any(~inblock):
+        pos[~inblock] = w + np.searchsorted(struct_s, idx[~inblock])
+    return pos
+
+
+def build_frontal_plan(sym: SymbolicFactorization,
+                       coo_rows: np.ndarray, coo_cols: np.ndarray,
+                       width_buckets: tuple, front_buckets: tuple,
+                       ) -> FrontalPlan:
+    """coo_rows/cols: the input matrix pattern in FINAL (postordered,
+    permuted) labels, in the caller's value-array order."""
+    part = sym.part
+    ns = part.nsuper
+    xsup = part.xsup
+    n = int(xsup[-1])
+
+    w = np.diff(xsup).astype(np.int64)
+    r = np.array([len(s) for s in sym.struct], dtype=np.int64)
+    m = w + r
+    wb = bucketize(w, width_buckets)
+    # the front must hold the padded pivot block plus all true rows
+    mb = bucketize(np.maximum(wb + r, m), front_buckets)
+
+    I = [np.concatenate([np.arange(xsup[s], xsup[s + 1]), sym.struct[s]])
+         for s in range(ns)]
+
+    # --- A-entry ownership: supernode of min(i,j) ---
+    k = np.minimum(coo_rows, coo_cols)
+    owner = part.supno[k]
+    order = np.argsort(owner, kind="stable")
+    bounds = np.searchsorted(owner[order], np.arange(ns + 1))
+    a_src: List[np.ndarray] = []
+    a_lr: List[np.ndarray] = []
+    a_lc: List[np.ndarray] = []
+    for s in range(ns):
+        sel = order[bounds[s]:bounds[s + 1]]
+        first, last = int(xsup[s]), int(xsup[s + 1] - 1)
+        lr = _local_positions(I[s], first, last, sym.struct[s], coo_rows[sel])
+        lc = _local_positions(I[s], first, last, sym.struct[s], coo_cols[sel])
+        a_src.append(sel)
+        a_lr.append(lr)
+        a_lc.append(lc)
+
+    # --- extend-add maps ---
+    ea_map: List[np.ndarray] = []
+    for s in range(ns):
+        p = part.sparent[s]
+        if p == -1 or r[s] == 0:
+            ea_map.append(np.empty(0, dtype=np.int64))
+            continue
+        firstp, lastp = int(xsup[p]), int(xsup[p + 1] - 1)
+        pos = _local_positions(I[p], firstp, lastp, sym.struct[p],
+                               sym.struct[s])
+        ea_map.append(pos)
+
+    # --- level schedule ---
+    nlev = int(part.levels.max()) + 1 if ns else 0
+    level_supernodes = [np.where(part.levels == lv)[0] for lv in range(nlev)]
+
+    # true flops: partial LU (2/3 w³) + two TRSMs (w²r each) + GEMM (2wr²)
+    wf = w.astype(np.float64)
+    rf = r.astype(np.float64)
+    factor_flops = float(np.sum(2.0 / 3.0 * wf**3 + 2.0 * wf * wf * rf
+                                + 2.0 * wf * rf * rf))
+
+    return FrontalPlan(sym=sym, n=n, w=w, r=r, m=m, wb=wb, mb=mb, I=I,
+                       a_src=a_src, a_lr=a_lr, a_lc=a_lc, ea_map=ea_map,
+                       level_supernodes=level_supernodes,
+                       factor_flops=factor_flops)

@@ -1,0 +1,165 @@
+"""FactorPlan: the once-per-pattern preprocessing product.
+
+This object is the TPU-native analog of everything pdgssvx computes
+before the numeric factorization (SRC/pdgssvx.c:718-1166: equil →
+rowperm → colperm → etree → symbfact → distribute) bundled into one
+cacheable value.  In JAX terms it is the static "plan" keyed by the
+sparsity pattern: the Fact reuse ladder (SRC/superlu_defs.h:577-598)
+falls out naturally — SamePattern reuses the plan minus row
+perm/scalings, SamePattern_SameRowPerm reuses all of it, FACTORED
+additionally reuses device factor buffers (models/gssvx.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..options import Options
+from ..sparse import CSRMatrix
+from ..utils.stats import Stats
+from . import colperm as colperm_mod
+from . import equilibrate, rowperm
+from .etree import (col_counts_postordered, etree_symmetric, postorder,
+                    relabel_tree)
+from .frontal import FrontalPlan, build_frontal_plan
+from .supernodes import find_supernodes
+from .symbolic import symbolic_factorize
+
+
+@dataclasses.dataclass
+class FactorPlan:
+    n: int
+    options: Options
+    # scalings (identity when Equil decided not to apply)
+    equed: str
+    row_scale: np.ndarray
+    col_scale: np.ndarray
+    # permutations, "newpos = perm[old]" convention
+    perm_r: np.ndarray        # static pivoting row perm
+    perm_c: np.ndarray        # fill-reducing col perm (pre-postorder)
+    post: np.ndarray          # postorder (old label of new position)
+    final_row: np.ndarray     # composed: original row -> factor row
+    final_col: np.ndarray     # composed: original col -> factor col
+    # original-matrix COO pattern (assembly references this order)
+    coo_rows: np.ndarray
+    coo_cols: np.ndarray
+    # symbolic + frontal structure
+    frontal: FrontalPlan
+    anorm: float
+
+    @property
+    def nsuper(self) -> int:
+        return self.frontal.nsuper
+
+    @property
+    def factor_flops(self) -> float:
+        return self.frontal.factor_flops
+
+    def lu_nnz(self) -> int:
+        return self.frontal.sym.lu_nnz()
+
+    def scaled_values(self, a: CSRMatrix) -> np.ndarray:
+        """Scaled value array Dr·A·Dc in the plan's COO order — the
+        value-refresh entry point for SamePattern reuse."""
+        vals = a.data
+        return (vals * self.row_scale[self.coo_rows]
+                * self.col_scale[self.coo_cols])
+
+
+def plan_factorization(a: CSRMatrix, options: Options | None = None,
+                       stats: Stats | None = None,
+                       user_perm_r: np.ndarray | None = None,
+                       user_perm_c: np.ndarray | None = None) -> FactorPlan:
+    """Run the full preprocessing pipeline on the host."""
+    options = options or Options()
+    stats = stats if stats is not None else Stats()
+    if a.m != a.n:
+        raise ValueError("solver requires a square matrix")
+    n = a.n
+
+    coo_rows, coo_cols, _ = a.to_coo()
+
+    # [Equil] (pdgssvx.c:718,736)
+    with stats.timer("EQUIL"):
+        if options.equil:
+            r, c, rowcnd, colcnd, amax = equilibrate.gsequ(a)
+            equed, r_eff, c_eff = equilibrate.laqgs(
+                a, r, c, rowcnd, colcnd, amax)
+        else:
+            equed = "N"
+            r_eff = np.ones(n)
+            c_eff = np.ones(n)
+    scaled_vals = a.data * r_eff[coo_rows] * c_eff[coo_cols]
+    a_scaled = CSRMatrix(a.m, a.n, a.indptr, a.indices, scaled_vals)
+
+    # [RowPerm] (pdgssvx.c:815)
+    with stats.timer("ROWPERM"):
+        perm_r = rowperm.get_perm_r(a_scaled, options.row_perm, user_perm_r)
+
+    # [ColPerm] on Pr·A (pdgssvx.c:1016-1029)
+    with stats.timer("COLPERM"):
+        a_rp = sp.coo_matrix(
+            (scaled_vals, (perm_r[coo_rows], coo_cols)), shape=(n, n)).tocsr()
+        perm_c = colperm_mod.get_perm_c(
+            CSRMatrix(n, n, a_rp.indptr.astype(np.int64),
+                      a_rp.indices.astype(np.int64), a_rp.data),
+            options.col_perm, user_perm_c)
+
+    # rows/cols after Pr then symmetric Pc
+    r1 = perm_c[perm_r[coo_rows]]
+    c1 = perm_c[coo_cols]
+
+    # [Etree + postorder] (sp_colorder, pdgssvx.c:1046)
+    with stats.timer("ETREE"):
+        ones = np.ones(len(coo_rows))
+        b1 = sp.coo_matrix((ones, (r1, c1)), shape=(n, n))
+        b1 = (b1 + b1.T + sp.eye(n)).tocsr()
+        b1.sort_indices()
+        parent1 = etree_symmetric(b1.indptr, b1.indices, n)
+        post = postorder(parent1)
+        invpost = np.empty(n, dtype=np.int64)
+        invpost[post] = np.arange(n)
+        parent = relabel_tree(parent1, post)
+
+    # composed length-n permutation maps: original label -> factor label
+    final_row = invpost[perm_c[perm_r]]
+    final_col = invpost[perm_c]
+    fr = final_row[coo_rows]
+    fc = final_col[coo_cols]
+
+    # symmetrized pattern in final order
+    b = sp.coo_matrix((np.ones(len(fr)), (fr, fc)),
+                      shape=(n, n))
+    b = (b + b.T + sp.eye(n)).tocsr()
+    b.sort_indices()
+    b_indptr = b.indptr.astype(np.int64)
+    b_indices = b.indices.astype(np.int64)
+
+    # [Symbfact] (pdgssvx.c:1075)
+    with stats.timer("SYMBFACT"):
+        colcount = col_counts_postordered(b_indptr, b_indices, parent)
+        part = find_supernodes(parent, colcount,
+                               options.relax, options.max_super)
+        sym = symbolic_factorize(b_indptr, b_indices, part)
+
+    # [Dist-plan] frontal maps (the pddistribute analog — here it
+    # produces static index maps instead of MPI send lists)
+    with stats.timer("DIST"):
+        frontal = build_frontal_plan(
+            sym, fr, fc,
+            options.width_buckets, options.front_buckets)
+
+    anorm = float(np.max(np.abs(scaled_vals))) if len(scaled_vals) else 1.0
+
+    plan = FactorPlan(
+        n=n, options=options, equed=equed,
+        row_scale=r_eff, col_scale=c_eff,
+        perm_r=perm_r, perm_c=perm_c, post=post,
+        final_row=final_row, final_col=final_col,
+        coo_rows=coo_rows, coo_cols=coo_cols,
+        frontal=frontal, anorm=anorm)
+    stats.lu_nnz = plan.lu_nnz()
+    return plan

@@ -1,0 +1,64 @@
+"""Static-pivoting row permutation: put large entries on the diagonal.
+
+Analog of dldperm_dist → mc64ad_dist (SRC/dldperm_dist.c:96,
+SRC/mc64ad_dist.c:121; dispatched at SRC/pdgssvx.c:815) and the HWPM
+path (SRC/d_c2cpp_GetHWPM.cpp).  The numerical-stability contract of
+GESP: after this permutation (plus equilibration) the diagonal is as
+large as possible, so the numeric factorization needs no pivoting —
+which is what makes the whole solver a fixed XLA-compilable DAG.
+
+MC64 job=5 (maximize the product of diagonal magnitudes) is realized
+as a min-weight full bipartite matching on C[i,j] =
+log(max_i|a_ij| / |a_ij|) — the standard Duff–Koster transform.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+from scipy.sparse.csgraph import min_weight_full_bipartite_matching
+
+from ..options import RowPerm
+from ..sparse import CSRMatrix
+
+
+def large_diag_perm(a: CSRMatrix) -> np.ndarray:
+    """Return perm_r with perm_r[i] = new position of row i, such that
+    (Pr·A) has a structurally perfect, product-maximal diagonal."""
+    rows, cols, vals = a.to_coo()
+    absv = np.abs(vals)
+    if np.any(absv == 0.0):
+        keep = absv > 0.0
+        rows, cols, absv = rows[keep], cols[keep], absv[keep]
+    # column-wise max (matching runs on the bipartite rows×cols graph;
+    # normalizing per column keeps weights ≥ 0 as MC64 does)
+    cmax = np.zeros(a.n)
+    np.maximum.at(cmax, cols, absv)
+    if np.any(cmax == 0.0):
+        raise ValueError("structurally singular: empty column")
+    w = np.log(cmax[cols]) - np.log(absv)
+    # biadjacency with strictly positive stored weights (shift by 1)
+    g = sp.csr_matrix((w + 1.0, (rows, cols)), shape=(a.m, a.n))
+    try:
+        row_ind, col_ind = min_weight_full_bipartite_matching(g)
+    except ValueError as e:
+        raise ValueError(f"structurally singular matrix: {e}") from e
+    perm_r = np.empty(a.m, dtype=np.int64)
+    # row row_ind[k] is matched to column col_ind[k]: send it to
+    # position col_ind[k] so the matched entry lands on the diagonal
+    perm_r[row_ind] = col_ind
+    return perm_r
+
+
+def get_perm_r(a: CSRMatrix, mode: RowPerm,
+               user_perm_r: np.ndarray | None = None) -> np.ndarray:
+    if mode == RowPerm.NOROWPERM:
+        return np.arange(a.m, dtype=np.int64)
+    if mode == RowPerm.MY_PERMR:
+        if user_perm_r is None:
+            raise ValueError("RowPerm.MY_PERMR requires user_perm_r")
+        return np.asarray(user_perm_r, dtype=np.int64)
+    # LARGE_DIAG_MC64 and LARGE_DIAG_HWPM both map to the matching;
+    # the reference's distinction is serial-vs-parallel execution
+    # (SRC/pdgssvx.c:815,919), not a different mathematical object.
+    return large_diag_perm(a)

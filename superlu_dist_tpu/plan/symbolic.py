@@ -1,0 +1,94 @@
+"""Supernodal symbolic factorization (host).
+
+Analog of symbfact (SRC/symbfact.c:81) producing the compressed L/U
+graphs of Glu_freeable_t (SRC/superlu_defs.h:494-505).  Because the TPU
+build plans on the symmetrized pattern B = pattern(A)+pattern(A)ᵀ
+(SURVEY.md §7), L and Uᵀ share one structure and a single supernodal
+union pass over the (postordered) supernodal etree suffices:
+
+    struct(s) = ( rows(B, cols(s)) ∪ ⋃_{c child of s} struct(c) )
+                 \\ {i ≤ last col of s}
+
+struct(s) is the sorted set of off-supernode row indices of the L panel
+of s (equally: column indices of the U panel).  The invariant
+struct(c) ⊆ cols(parent) ∪ struct(parent) — guaranteed by etree theory
+plus column contiguity of supernodes — is what makes the multifrontal
+extend-add maps (plan/frontal.py) well-defined; it is asserted in
+tests/test_plan.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .supernodes import SupernodePartition
+
+
+@dataclasses.dataclass
+class SymbolicFactorization:
+    part: SupernodePartition
+    struct: List[np.ndarray]   # per-supernode sorted off-block row indices
+    children: List[np.ndarray]  # per-supernode child supernode ids
+
+    @property
+    def nsuper(self) -> int:
+        return self.part.nsuper
+
+    def lu_nnz(self) -> int:
+        """nnz(L+U) counted like dQuerySpace_dist: dense w×w diagonal
+        blocks + both panels."""
+        xsup = self.part.xsup
+        total = 0
+        for s in range(self.nsuper):
+            w = int(xsup[s + 1] - xsup[s])
+            r = len(self.struct[s])
+            total += w * w + 2 * w * r
+        return total
+
+
+def symbolic_factorize(b_indptr: np.ndarray, b_indices: np.ndarray,
+                       part: SupernodePartition) -> SymbolicFactorization:
+    """B is the symmetrized pattern CSR in the final (postordered)
+    column order."""
+    ns = part.nsuper
+    xsup = part.xsup
+    children: List[list] = [[] for _ in range(ns)]
+    for s in range(ns):
+        p = part.sparent[s]
+        if p != -1:
+            children[p].append(s)
+
+    struct: List[np.ndarray] = [None] * ns  # type: ignore
+    for s in range(ns):
+        first, last = int(xsup[s]), int(xsup[s + 1] - 1)
+        pieces = [b_indices[b_indptr[j]:b_indptr[j + 1]]
+                  for j in range(first, last + 1)]
+        pieces += [struct[c] for c in children[s]]
+        rows = np.concatenate(pieces) if pieces else np.empty(0, np.int64)
+        rows = np.unique(rows)
+        struct[s] = rows[rows > last].astype(np.int64)
+
+    return SymbolicFactorization(
+        part=part,
+        struct=struct,
+        children=[np.asarray(c, dtype=np.int64) for c in children],
+    )
+
+
+def brute_force_struct(b_indptr, b_indices, n):
+    """Column-by-column symbolic Cholesky (test oracle): returns list of
+    sorted strictly-below-diagonal row sets per column and parent[]."""
+    cols = [None] * n
+    parent = np.full(n, -1, dtype=np.int64)
+    for j in range(n):
+        rows = set(int(i) for i in b_indices[b_indptr[j]:b_indptr[j + 1]]
+                   if i > j)
+        for k in range(j):
+            if parent[k] == j:
+                rows |= {i for i in cols[k] if i > j}
+        cols[j] = sorted(rows)
+        parent[j] = cols[j][0] if cols[j] else -1
+    return cols, parent

@@ -1,0 +1,70 @@
+"""Execution statistics and per-phase timing.
+
+Analog of SuperLUStat_t (SRC/util_dist.h:101-123), the PhaseType keys
+(SRC/superlu_enum_consts.h:66-90) and PStatPrint (SRC/util.c:331).  On
+TPU the timers bracket `jax.block_until_ready` so device work is
+attributed to the right phase (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict
+
+
+# Phase keys mirroring PhaseType (SRC/superlu_enum_consts.h:66-90)
+PHASES = (
+    "EQUIL", "ROWPERM", "COLPERM", "ETREE", "SYMBFACT", "DIST",
+    "FACT", "SOLVE", "REFINE", "SPMV",
+)
+
+
+@dataclasses.dataclass
+class Stats:
+    utime: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {p: 0.0 for p in PHASES})
+    ops: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {p: 0.0 for p in PHASES})
+    tiny_pivots: int = 0
+    refine_steps: int = 0
+    berr: float = 0.0
+    # memory accounting (dQuerySpace_dist analog, SRC/superlu_ddefs.h:616)
+    lu_nnz: int = 0
+    lu_bytes: int = 0
+    workspace_bytes: int = 0
+
+    @contextlib.contextmanager
+    def timer(self, phase: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.utime[phase] = self.utime.get(phase, 0.0) + (
+                time.perf_counter() - t0)
+
+    def add_ops(self, phase: str, flops: float) -> None:
+        self.ops[phase] = self.ops.get(phase, 0.0) + flops
+
+    def gflops(self, phase: str) -> float:
+        t = self.utime.get(phase, 0.0)
+        return (self.ops.get(phase, 0.0) / t / 1e9) if t > 0 else 0.0
+
+    def report(self) -> str:
+        """PStatPrint-style report (SRC/util.c:331)."""
+        lines = ["** Phase breakdown **"]
+        for p in PHASES:
+            t = self.utime.get(p, 0.0)
+            if t == 0.0 and self.ops.get(p, 0.0) == 0.0:
+                continue
+            line = f"  {p:<10s} {t * 1e3:10.2f} ms"
+            if self.ops.get(p, 0.0) > 0:
+                line += f"  {self.gflops(p):8.2f} GF/s"
+            lines.append(line)
+        lines.append(f"  tiny pivots replaced: {self.tiny_pivots}")
+        lines.append(f"  refinement steps:     {self.refine_steps}")
+        if self.lu_nnz:
+            lines.append(
+                f"  nnz(L+U): {self.lu_nnz}  LU bytes: {self.lu_bytes}")
+        return "\n".join(lines)

@@ -1,0 +1,12 @@
+"""Test configuration: force an 8-virtual-device CPU platform so mesh
+sharding tests run anywhere (the reference's analog is the
+oversubscribed-local-MPI-ranks CTest sweep, TEST/CMakeLists.txt:48-53).
+Must run before jax initializes."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

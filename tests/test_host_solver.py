@@ -1,0 +1,118 @@
+"""End-to-end correctness of the host (numpy reference) backend against
+scipy.sparse.linalg.splu — the test oracle prescribed by SURVEY.md §4."""
+
+import numpy as np
+import pytest
+import scipy.sparse.linalg as spla
+
+from superlu_dist_tpu import Options, gssvx
+from superlu_dist_tpu.options import ColPerm, IterRefine, RowPerm
+from superlu_dist_tpu.utils.testmat import (convection_diffusion_2d,
+                                            laplacian_2d,
+                                            manufactured_rhs,
+                                            random_unsymmetric)
+
+
+def residual_metric(a, x, b):
+    """‖B−AX‖ / (‖A‖·‖X‖·eps) — the pdcompute_resid check
+    (TEST/pdcompute_resid.c:33); pass threshold O(10)."""
+    s = a.to_scipy()
+    r = b - s @ x
+    eps = np.finfo(np.float64).eps
+    denom = (spla.norm(s, np.inf) * np.linalg.norm(x, np.inf) * eps
+             * a.n)
+    return np.linalg.norm(r, np.inf) / max(denom, 1e-300)
+
+
+MATRICES = {
+    "lap12": lambda: laplacian_2d(12),
+    "lap20": lambda: laplacian_2d(20),
+    "cd14": lambda: convection_diffusion_2d(14),
+    "rand200": lambda: random_unsymmetric(200, 0.03, seed=11),
+}
+
+
+@pytest.mark.parametrize("name", list(MATRICES))
+@pytest.mark.parametrize("colperm", [ColPerm.MMD_AT_PLUS_A,
+                                     ColPerm.METIS_AT_PLUS_A])
+def test_solve_matches_truth(name, colperm):
+    a = MATRICES[name]()
+    xtrue, b = manufactured_rhs(a)
+    opts = Options(col_perm=colperm)
+    x, lu, stats = gssvx(opts, a, b, backend="host")
+    assert residual_metric(a, x[:, None] if x.ndim == 1 else x,
+                           b[:, None] if b.ndim == 1 else b) < 30.0
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+
+
+def test_multirhs():
+    a = laplacian_2d(10)
+    xtrue, b = manufactured_rhs(a, nrhs=7)
+    x, _, _ = gssvx(Options(), a, b, backend="host")
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+
+
+def test_weak_diagonal_needs_static_pivoting():
+    """A matrix whose diagonal is (partly) zero: NOROWPERM would break
+    down; MC64-analog matching must fix it."""
+    a = random_unsymmetric(120, 0.05, seed=3)
+    # zero out some diagonal entries by constructing b = P a
+    s = a.to_scipy().tolil()
+    rng = np.random.default_rng(0)
+    # swap some rows to move large entries off the diagonal
+    idx = rng.permutation(120)
+    s = s[idx]
+    from superlu_dist_tpu.sparse import csr_from_scipy
+    a2 = csr_from_scipy(s.tocsr())
+    xtrue, b = manufactured_rhs(a2)
+    x, _, stats = gssvx(Options(row_perm=RowPerm.LARGE_DIAG_MC64), a2, b,
+                        backend="host")
+    np.testing.assert_allclose(x, xtrue, rtol=1e-6, atol=1e-6)
+
+
+def test_vs_scipy_splu():
+    a = convection_diffusion_2d(12)
+    _, b = manufactured_rhs(a)
+    x_ref = spla.splu(a.to_scipy().tocsc()).solve(b)
+    x, _, _ = gssvx(Options(), a, b, backend="host")
+    np.testing.assert_allclose(x, x_ref, rtol=1e-9, atol=1e-9)
+
+
+def test_refinement_reduces_berr():
+    a = convection_diffusion_2d(10, wind=80.0)
+    _, b = manufactured_rhs(a)
+    opts = Options(factor_dtype="float32", refine_dtype="float64",
+                   iter_refine=IterRefine.SLU_DOUBLE)
+    x, _, stats = gssvx(opts, a, b, backend="host")
+    # mixed precision: f32 factor + f64 refinement must reach near-f64
+    # accuracy (the psgssvx_d2 contract, SRC/psgssvx_d2.c:516)
+    assert stats.refine_steps >= 1
+    xtrue = spla.spsolve(a.to_scipy().tocsr(), b)
+    assert np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue) < 1e-6
+
+
+def test_fact_reuse_ladder():
+    from superlu_dist_tpu import Fact
+    a = laplacian_2d(8)
+    _, b = manufactured_rhs(a)
+    x0, lu, _ = gssvx(Options(), a, b, backend="host")
+
+    # FACTORED: solve only
+    x1, lu1, _ = gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+                       backend="host")
+    np.testing.assert_allclose(x1, x0)
+
+    # SamePattern: new values, reuse the column ordering but recompute
+    # row perm/scalings/symbolic (the reference's SamePattern rung)
+    a2 = type(a)(a.m, a.n, a.indptr, a.indices, a.data * 2.0)
+    x2, lu2, _ = gssvx(Options(fact=Fact.SAME_PATTERN), a2, b, lu=lu,
+                       backend="host")
+    np.testing.assert_allclose(x2, x0 / 2.0, rtol=1e-10)
+    assert lu2.plan is not lu.plan
+    np.testing.assert_array_equal(lu2.plan.perm_c, lu.plan.perm_c)
+
+    # SamePattern_SameRowPerm: reuse the entire plan object
+    x3, lu3, _ = gssvx(Options(fact=Fact.SAME_PATTERN_SAME_ROWPERM),
+                       a2, b, lu=lu, backend="host")
+    np.testing.assert_allclose(x3, x0 / 2.0, rtol=1e-10)
+    assert lu3.plan is lu.plan

@@ -1,0 +1,133 @@
+"""Plan-layer tests: etree, column counts, supernodes, symbolic
+structure invariants — oracle-checked against brute force."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu.options import ColPerm, Options, RowPerm
+from superlu_dist_tpu.plan.etree import (col_counts_postordered,
+                                         etree_symmetric, postorder,
+                                         relabel_tree)
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.plan.symbolic import brute_force_struct
+from superlu_dist_tpu.utils.testmat import (convection_diffusion_2d,
+                                            laplacian_2d,
+                                            random_unsymmetric)
+
+
+def _random_sym_pattern(n, density, seed):
+    rng = np.random.default_rng(seed)
+    a = sp.random(n, n, density=density, random_state=rng)
+    b = (a + a.T + sp.eye(n)).tocsr()
+    b.sort_indices()
+    return b.indptr.astype(np.int64), b.indices.astype(np.int64)
+
+
+@pytest.mark.parametrize("n,density,seed", [
+    (30, 0.1, 0), (60, 0.05, 1), (100, 0.03, 2), (12, 0.3, 3)])
+def test_etree_and_colcounts_vs_bruteforce(n, density, seed):
+    indptr, indices, = _random_sym_pattern(n, density, seed)
+    parent = etree_symmetric(indptr, indices, n)
+    cols, bf_parent = brute_force_struct(indptr, indices, n)
+    np.testing.assert_array_equal(parent, bf_parent)
+
+    # postorder + relabel, then colcounts must match brute force
+    post = postorder(parent)
+    invpost = np.empty(n, dtype=np.int64)
+    invpost[post] = np.arange(n)
+    b = sp.csr_matrix((np.ones(len(indices)), indices, indptr), (n, n))
+    bp = b[post][:, post].tocsr()
+    bp.sort_indices()
+    parent2 = relabel_tree(parent, post)
+    assert np.all((parent2 == -1) | (parent2 > np.arange(n)))
+    cc = col_counts_postordered(bp.indptr.astype(np.int64),
+                                bp.indices.astype(np.int64), parent2)
+    cols2, _ = brute_force_struct(bp.indptr.astype(np.int64),
+                                  bp.indices.astype(np.int64), n)
+    bf_cc = np.array([len(c) + 1 for c in cols2])
+    np.testing.assert_array_equal(cc, bf_cc)
+
+
+@pytest.mark.parametrize("mat", ["lap", "cd", "rand"])
+@pytest.mark.parametrize("colperm", [ColPerm.NATURAL, ColPerm.RCM,
+                                     ColPerm.MMD_AT_PLUS_A,
+                                     ColPerm.METIS_AT_PLUS_A])
+def test_plan_invariants(mat, colperm):
+    a = {"lap": lambda: laplacian_2d(12),
+         "cd": lambda: convection_diffusion_2d(10),
+         "rand": lambda: random_unsymmetric(80, 0.05, seed=4)}[mat]()
+    opts = Options(col_perm=colperm, relax=4, max_super=16)
+    plan = plan_factorization(a, opts)
+    fp = plan.frontal
+    part = fp.sym.part
+    n = plan.n
+
+    # permutations are permutations
+    for p in (plan.perm_r, plan.perm_c, plan.final_row, plan.final_col):
+        assert sorted(p) == list(range(n))
+
+    # supernode partition covers all columns contiguously
+    assert part.xsup[0] == 0 and part.xsup[-1] == n
+    assert np.all(np.diff(part.xsup) >= 1)
+
+    # structure entries strictly below the supernode, sorted
+    for s in range(fp.nsuper):
+        st = fp.sym.struct[s]
+        assert np.all(np.diff(st) > 0)
+        assert np.all(st > part.xsup[s + 1] - 1)
+        # extend-add containment invariant
+        p = part.sparent[s]
+        if p != -1:
+            Ip = fp.I[p]
+            assert np.all(np.isin(st, Ip)), \
+                "child struct not contained in parent front"
+            np.testing.assert_array_equal(Ip[fp.ea_map[s]], st)
+
+    # every A entry assembled exactly once
+    total = sum(len(src) for src in fp.a_src)
+    assert total == a.nnz
+    seen = np.concatenate([src for src in fp.a_src])
+    assert len(np.unique(seen)) == a.nnz
+
+    # assembled local positions in range
+    for s in range(fp.nsuper):
+        m = fp.m[s]
+        assert np.all(fp.a_lr[s] < m) and np.all(fp.a_lc[s] < m)
+        # pivot-ownership: each entry has min(row,col) inside the block
+        assert np.all(np.minimum(fp.a_lr[s], fp.a_lc[s]) < fp.w[s])
+
+    # level schedule: children strictly earlier than parents
+    lev = part.levels
+    for s in range(fp.nsuper):
+        if part.sparent[s] != -1:
+            assert lev[s] < lev[part.sparent[s]]
+
+    # buckets dominate true sizes
+    assert np.all(fp.wb >= fp.w) and np.all(fp.mb >= fp.wb + fp.r)
+
+
+def test_rowperm_puts_large_diagonal():
+    a = random_unsymmetric(60, 0.08, seed=7)
+    opts = Options(col_perm=ColPerm.NATURAL)
+    plan = plan_factorization(a, opts)
+    s = a.to_scipy().tocoo()
+    vals = plan.scaled_values(a)
+    # permuted diagonal must be structurally full
+    pr = plan.perm_r
+    diag_hits = np.sum(pr[s.row] == s.col)
+    assert diag_hits == a.n
+    # and reasonably large: product of |diag| >= product of any random perm
+    diag_mask = pr[s.row] == s.col
+    assert np.all(np.abs(vals[diag_mask]) > 0)
+
+
+def test_nd_order_reduces_fill_vs_natural():
+    a = laplacian_2d(24)  # n = 576
+    nnz = {}
+    for cp in (ColPerm.NATURAL, ColPerm.METIS_AT_PLUS_A):
+        plan = plan_factorization(
+            a, Options(col_perm=cp, row_perm=RowPerm.NOROWPERM,
+                       relax=8, max_super=64))
+        nnz[cp] = plan.lu_nnz()
+    assert nnz[ColPerm.METIS_AT_PLUS_A] < nnz[ColPerm.NATURAL]
