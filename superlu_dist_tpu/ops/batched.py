@@ -1,0 +1,479 @@
+"""Level-batched bucketed multifrontal execution (the TPU numeric core).
+
+This is the device engine replacing the reference's pdgstrf hot loop
+(SRC/pdgstrf.c:1108) and tree factorization
+(SRC/dtreeFactorization.c:265): the supernodal etree is executed
+level-synchronously from the leaves (SURVEY.md §7 "level-synchronous
+execution"); within a level, all fronts with the same padded bucket
+shape (wb, mb) batch into one vmapped kernel invocation:
+
+    scatter-assemble A entries + identity padding + child updates
+    → batched blocked partial LU (ops/dense_lu.py, MXU)
+    → slab writes of L/U panels + diag-block inverses
+    → update matrices into a flat extend-add buffer
+
+All indices are precomputed on the host once per pattern
+(BatchedSchedule, cached on the FactorPlan — the SamePattern rung) and
+padded to bucketed lengths/counts so the jit cache is keyed by a small
+bounded set of shapes.  The flat `_dat/_offset` slab layout mirrors
+the reference's GPU LU mirrors (SRC/superlu_ddefs.h:99-132), the right
+model for HBM-resident factors.
+
+ONE schedule builder serves both execution modes: `build_schedule(plan,
+ndev)` block-partitions every level/bucket group's fronts across `ndev`
+devices (ndev=1 → the single-device path; ndev>1 → the shard_map path
+in parallel/factor_dist.py, where the update-slab layout is
+device-major so ancestor propagation is a single tiled all_gather —
+the TPU form of dreduceAncestors3d, SRC/pd3dcomm.c:704).
+
+The triangular solve walks the same schedule forwards then backwards
+with the diag-inverse GEMM formulation (DiagInv=YES,
+SRC/pdgssvx.c:1436-1447): x1 = inv(L11)·b1, then scatter-add of
+L21·x1 — the lsum/fmod dataflow of SRC/pdgstrs_lsum.c as batched
+matmuls instead of message-driven GEMVs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..plan.plan import FactorPlan
+from .dense_lu import partial_lu_batch, unit_lower_inverse, upper_inverse
+
+
+def _next_pow2(x: int) -> int:
+    return 1 if x <= 1 else 1 << (x - 1).bit_length()
+
+
+def _pad_idx(arr: np.ndarray, fill: int) -> np.ndarray:
+    """Pad an index array to the next power-of-FOUR length: coarser
+    quantization keeps the jit shape-key set small (compile count is
+    the dominant setup cost), at ≤4× scatter-index overhead."""
+    n = max(len(arr), 1)
+    target = 1
+    while target < n:
+        target *= 4
+    out = np.full(target, fill, dtype=np.int64)
+    out[:len(arr)] = arr
+    return out
+
+
+def _pad_pos(pos: np.ndarray, w: int, wb: int) -> np.ndarray:
+    """Unpadded front position -> padded front position (pivot block
+    padded from w to wb shifts the struct rows up by wb-w)."""
+    return np.where(pos < w, pos, pos + (wb - w))
+
+
+@dataclasses.dataclass
+class GroupSpec:
+    """One (level, bucket) batch of fronts, block-partitioned over
+    `ndev` devices.  All index arrays are stacked (ndev, ...)."""
+    level: int
+    mb: int
+    wb: int
+    n_loc: int                 # fronts per device (padded)
+    n_true: int                # true front count across devices
+    sup_ids: np.ndarray
+    a_src: np.ndarray          # (ndev, La) into vals (+ zero slot)
+    a_dst: np.ndarray          # (ndev, La) local-front linear indices
+    one_dst: np.ndarray        # (ndev, Lo)
+    ea_src: np.ndarray         # (ndev, Le) into replicated upd_buf
+    ea_dst: np.ndarray         # (ndev, Le)
+    col_idx: np.ndarray        # (ndev, n_loc, wb) global cols, pad -> n
+    struct_idx: np.ndarray     # (ndev, n_loc, mb-wb) pad -> n
+    upd_off_global: int        # start of this group's global slab
+    L_off: int                 # per-device local flat offsets
+    U_off: int
+    Li_off: int
+    Ui_off: int
+    _dev: Optional[Tuple] = None  # lazy device-array cache
+
+    def dev(self, squeeze: bool):
+        """Device copies of the index arrays (cached).  squeeze=True
+        drops the leading ndev=1 axis for the single-device path."""
+        if self._dev is None:
+            f_loc = self.n_loc * self.mb * self.mb
+            fdt = jnp.int32 if f_loc < 2**31 - 1 else jnp.int64
+            sdt = (jnp.int32 if int(self.a_src.max(initial=0)) < 2**31 - 1
+                   else jnp.int64)
+            edt = (jnp.int32 if int(self.ea_src.max(initial=0)) < 2**31 - 1
+                   else jnp.int64)
+            arrs = (
+                jnp.asarray(self.a_src, dtype=sdt),
+                jnp.asarray(self.a_dst, dtype=fdt),
+                jnp.asarray(self.one_dst, dtype=fdt),
+                jnp.asarray(self.ea_src, dtype=edt),
+                jnp.asarray(self.ea_dst, dtype=fdt),
+                jnp.asarray(self.col_idx, dtype=jnp.int32),
+                jnp.asarray(self.struct_idx, dtype=jnp.int32),
+            )
+            if squeeze:
+                arrs = tuple(a[0] for a in arrs)
+            self._dev = arrs
+        return self._dev
+
+
+@dataclasses.dataclass
+class BatchedSchedule:
+    groups: List[GroupSpec]    # execution order, levels ascending
+    ndev: int
+    n: int
+    upd_total: int             # replicated update-buffer size (global)
+    L_total: int               # per-device flat sizes
+    U_total: int
+    Li_total: int
+    Ui_total: int
+
+
+def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
+    fp = plan.frontal
+    part = fp.sym.part
+    xsup = part.xsup
+    n = plan.n
+    nnz = len(plan.coo_rows)
+
+    sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
+    groups: List[GroupSpec] = []
+    upd_cursor = 0
+    L_cur = U_cur = Li_cur = Ui_cur = 0
+
+    for lv, sups in enumerate(fp.level_supernodes):
+        by_bucket = {}
+        for s in sups:
+            by_bucket.setdefault((int(fp.wb[s]), int(fp.mb[s])),
+                                 []).append(int(s))
+        for (wb, mb), slist in sorted(by_bucket.items()):
+            N = len(slist)
+            # pad per-device count to a power of two (jit key bound)
+            n_loc = _next_pow2(-(-N // ndev))
+            n_tot = n_loc * ndev
+            rb = mb - wb
+            f_loc = n_loc * mb * mb
+
+            per_dev = {k: [[] for _ in range(ndev)]
+                       for k in ("a_src", "a_dst", "one", "ea_src",
+                                 "ea_dst")}
+            col_idx = np.full((ndev, n_loc, wb), n, dtype=np.int64)
+            struct_idx = np.full((ndev, n_loc, rb), n, dtype=np.int64)
+
+            for bg, s in enumerate(slist):
+                d, b = divmod(bg, n_loc)
+                w = int(fp.w[s]); r = int(fp.r[s])
+                base = b * mb * mb
+                lr = _pad_pos(fp.a_lr[s], w, wb)
+                lc = _pad_pos(fp.a_lc[s], w, wb)
+                per_dev["a_src"][d].append(fp.a_src[s])
+                per_dev["a_dst"][d].append(base + lr * mb + lc)
+                if wb > w:
+                    t = np.arange(w, wb)
+                    per_dev["one"][d].append(base + t * mb + t)
+                for c in fp.sym.children[s]:
+                    rc = int(fp.r[c])
+                    if rc == 0:
+                        continue
+                    rbc = int(fp.mb[c]) - int(fp.wb[c])
+                    coff = sup_upd_off[c]
+                    assert coff >= 0, "child scheduled after parent"
+                    ii, jj = np.meshgrid(np.arange(rc), np.arange(rc),
+                                         indexing="ij")
+                    per_dev["ea_src"][d].append(
+                        coff + ii.ravel() * rbc + jj.ravel())
+                    pos = _pad_pos(fp.ea_map[c], w, wb)
+                    pi, pj = np.meshgrid(pos, pos, indexing="ij")
+                    per_dev["ea_dst"][d].append(
+                        base + pi.ravel() * mb + pj.ravel())
+                col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
+                struct_idx[d, b, :r] = fp.sym.struct[s]
+                # global update slab is device-major contiguous so an
+                # all_gather of local slabs reproduces it exactly
+                sup_upd_off[s] = upd_cursor + bg * rb * rb
+            # dummy fronts (including wholly idle devices): identity
+            # pivot block so the padded LU is well-defined
+            for bg in range(N, n_tot):
+                d, b = divmod(bg, n_loc)
+                t = np.arange(wb)
+                per_dev["one"][d].append(b * mb * mb + t * mb + t)
+
+            def stack(key, fill):
+                cat = [np.concatenate(v) if v else
+                       np.empty(0, dtype=np.int64)
+                       for v in per_dev[key]]
+                maxlen = max(len(c) for c in cat)
+                padded = [
+                    _pad_idx(np.concatenate(
+                        [c, np.full(maxlen - len(c), fill,
+                                    dtype=np.int64)]), fill)
+                    for c in cat]
+                return np.stack(padded)
+
+            groups.append(GroupSpec(
+                level=lv, mb=mb, wb=wb, n_loc=n_loc, n_true=N,
+                sup_ids=np.asarray(slist, dtype=np.int64),
+                a_src=stack("a_src", nnz),
+                a_dst=stack("a_dst", f_loc),     # OOB -> dropped
+                one_dst=stack("one", f_loc),
+                ea_src=stack("ea_src", -1),      # finalized below
+                ea_dst=stack("ea_dst", f_loc),
+                col_idx=col_idx, struct_idx=struct_idx,
+                upd_off_global=upd_cursor,
+                L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur))
+            upd_cursor += n_tot * rb * rb
+            L_cur += n_loc * mb * wb
+            U_cur += n_loc * wb * mb
+            Li_cur += n_loc * wb * wb
+            Ui_cur += n_loc * wb * wb
+
+    # ea_src pads -> index of the zero slot appended at upd_total
+    for g in groups:
+        g.ea_src[g.ea_src == -1] = upd_cursor
+
+    return BatchedSchedule(groups=groups, ndev=ndev, n=n,
+                           upd_total=upd_cursor,
+                           L_total=L_cur, U_total=U_cur,
+                           Li_total=Li_cur, Ui_total=Ui_cur)
+
+
+def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
+    cache = getattr(plan, "_batched_schedules", None)
+    if cache is None:
+        cache = plan._batched_schedules = {}
+    if ndev not in cache:
+        cache[ndev] = build_schedule(plan, ndev)
+    return cache[ndev]
+
+
+def _thresh_for(plan: FactorPlan, dtype: np.dtype) -> float:
+    rdt = np.finfo(
+        np.dtype(dtype.char.lower()) if dtype.kind == "c" else dtype)
+    if not plan.options.replace_tiny_pivot:
+        return 0.0
+    return float(np.sqrt(rdt.eps) * plan.anorm)
+
+
+def _real_dtype(dtype: np.dtype):
+    return np.dtype(dtype.char.lower()) if dtype.kind == "c" else dtype
+
+
+# --------------------------------------------------------------------
+# per-group bodies (shared by single-device jit and shard_map paths)
+# --------------------------------------------------------------------
+
+def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
+                       tiny, thresh, a_src, a_dst, one_dst, ea_src,
+                       ea_dst, upd_off, L_off, U_off, Li_off, Ui_off,
+                       *, mb: int, wb: int, n_pad: int):
+    dtype = L_flat.dtype
+    one = jnp.ones((), dtype)
+    F = jnp.zeros(n_pad * mb * mb, dtype)
+    F = F.at[a_dst].add(vals[a_src], mode="drop")
+    F = F.at[one_dst].set(one, mode="drop")
+    F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
+    F = F.reshape(n_pad, mb, mb)
+
+    F, tiny_g = partial_lu_batch(F, thresh, wb=wb)
+
+    rows = jnp.arange(mb)[:, None]
+    colsw = jnp.arange(wb)[None, :]
+    Lpanel = jnp.where(rows > colsw, F[:, :, :wb],
+                       jnp.where(rows == colsw, one, 0))
+    Upanel = jnp.where(colsw.T <= jnp.arange(mb)[None, :], F[:, :wb, :], 0)
+    Li = unit_lower_inverse(Lpanel[:, :wb, :])
+    Ui = upper_inverse(Upanel[:, :, :wb])
+
+    L_flat = jax.lax.dynamic_update_slice(L_flat, Lpanel.reshape(-1),
+                                          (L_off,))
+    U_flat = jax.lax.dynamic_update_slice(U_flat, Upanel.reshape(-1),
+                                          (U_off,))
+    Li_flat = jax.lax.dynamic_update_slice(Li_flat, Li.reshape(-1),
+                                           (Li_off,))
+    Ui_flat = jax.lax.dynamic_update_slice(Ui_flat, Ui.reshape(-1),
+                                           (Ui_off,))
+    if mb > wb:
+        upd = F[:, wb:, wb:]
+        upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd.reshape(-1),
+                                               (upd_off,))
+    return upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny + tiny_g
+
+
+_factor_group = functools.partial(
+    jax.jit,
+    static_argnames=("mb", "wb", "n_pad"),
+    donate_argnames=("upd_buf", "L_flat", "U_flat", "Li_flat",
+                     "Ui_flat"))(_factor_group_impl)
+
+
+def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
+                    Li_off, *, mb: int, wb: int, n_pad: int):
+    xb = X[col_idx]                                     # (Np, wb, nrhs)
+    Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
+                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    y = Li @ xb
+    X = X.at[col_idx].set(y)
+    if mb > wb:
+        Lp = jax.lax.dynamic_slice(
+            L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
+        X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
+    return X
+
+
+_fwd_group = functools.partial(
+    jax.jit, static_argnames=("mb", "wb", "n_pad"),
+    donate_argnames=("X",))(_fwd_group_impl)
+
+
+def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
+                    Ui_off, *, mb: int, wb: int, n_pad: int):
+    xb = X[col_idx]
+    if mb > wb:
+        Up = jax.lax.dynamic_slice(
+            U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
+        xs = X[struct_idx]
+        xb = xb - Up[:, :, wb:] @ xs
+    Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
+                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    X = X.at[col_idx].set(Ui @ xb)
+    return X
+
+
+_bwd_group = functools.partial(
+    jax.jit, static_argnames=("mb", "wb", "n_pad"),
+    donate_argnames=("X",))(_bwd_group_impl)
+
+
+# --------------------------------------------------------------------
+# single-device driver API
+# --------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DeviceLU:
+    """Flat device factor storage (dLocalLU_t analog; the slab layout
+    follows the reference's GPU flattened mirrors)."""
+    plan: FactorPlan
+    schedule: BatchedSchedule
+    dtype: np.dtype
+    L_flat: jnp.ndarray
+    U_flat: jnp.ndarray
+    Li_flat: jnp.ndarray
+    Ui_flat: jnp.ndarray
+    tiny_pivots: int
+
+
+def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
+                     dtype=np.float64) -> DeviceLU:
+    sched = get_schedule(plan, 1)
+    dtype = np.dtype(dtype)
+    thresh = jnp.asarray(_thresh_for(plan, dtype),
+                         dtype=_real_dtype(dtype))
+
+    vals = jnp.asarray(
+        np.concatenate([scaled_vals.astype(dtype), np.zeros(1, dtype)]))
+    upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+    L_flat = jnp.zeros(sched.L_total, dtype)
+    U_flat = jnp.zeros(sched.U_total, dtype)
+    Li_flat = jnp.zeros(sched.Li_total, dtype)
+    Ui_flat = jnp.zeros(sched.Ui_total, dtype)
+    tiny = jnp.zeros((), jnp.int32)
+
+    for g in sched.groups:
+        a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = g.dev(squeeze=True)
+        upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny = _factor_group(
+            vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+            thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
+            jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
+            jnp.int32(g.U_off), jnp.int32(g.Li_off),
+            jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+
+    return DeviceLU(plan=plan, schedule=sched, dtype=dtype,
+                    L_flat=L_flat, U_flat=U_flat,
+                    Li_flat=Li_flat, Ui_flat=Ui_flat,
+                    tiny_pivots=int(tiny))
+
+
+def solve_device(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
+    """b in factor ordering, (n,) or (n, nrhs); returns same shape."""
+    sched = lu.schedule
+    squeeze = b.ndim == 1
+    bb = b[:, None] if squeeze else b
+    # promote rather than cast: a complex rhs against a real factor
+    # must stay complex (matmuls promote; matches the host backend)
+    xdt = np.promote_types(lu.dtype, bb.dtype)
+    X = jnp.zeros((sched.n + 1, bb.shape[1]), xdt)
+    X = X.at[:sched.n, :].set(jnp.asarray(bb.astype(xdt)))
+
+    for g in sched.groups:
+        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+        X = _fwd_group(X, lu.L_flat, lu.Li_flat, col_idx, struct_idx,
+                       jnp.int32(g.L_off), jnp.int32(g.Li_off),
+                       mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+    for g in reversed(sched.groups):
+        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+        X = _bwd_group(X, lu.U_flat, lu.Ui_flat, col_idx, struct_idx,
+                       jnp.int32(g.U_off), jnp.int32(g.Ui_off),
+                       mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+
+    out = np.asarray(X[:sched.n])
+    return out[:, 0] if squeeze else out
+
+
+# --------------------------------------------------------------------
+# fused whole-pipeline step (one XLA program)
+# --------------------------------------------------------------------
+
+def make_fused_step(plan: FactorPlan, dtype=np.float64):
+    """Build `step(vals, b) -> x`: the ENTIRE numeric phase — assemble,
+    level-batched factorization, forward+backward trisolve — traced as
+    one jittable function.  This is the maximal-fusion formulation the
+    static-pivoting design exists to enable (SURVEY.md §7: after
+    preprocessing the numeric phase is a fixed DAG), and the function
+    the driver compile-checks (`__graft_entry__.entry`).
+
+    `vals` are the scaled values in plan COO order; `b` is the RHS in
+    factor ordering, shape (n, nrhs)."""
+    sched = get_schedule(plan, 1)
+    dtype = np.dtype(dtype)
+    thresh_np = _thresh_for(plan, dtype)
+
+    def step(vals, b):
+        thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
+        vals = jnp.concatenate(
+            [vals.astype(dtype), jnp.zeros(1, dtype)])
+        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+        L_flat = jnp.zeros(sched.L_total, dtype)
+        U_flat = jnp.zeros(sched.U_total, dtype)
+        Li_flat = jnp.zeros(sched.Li_total, dtype)
+        Ui_flat = jnp.zeros(sched.Ui_total, dtype)
+        tiny = jnp.zeros((), jnp.int32)
+        for g in sched.groups:
+            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = \
+                g.dev(squeeze=True)
+            upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny = \
+                _factor_group_impl(
+                    vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
+                    tiny, thresh, a_src, a_dst, one_dst, ea_src,
+                    ea_dst, jnp.int32(g.upd_off_global),
+                    jnp.int32(g.L_off), jnp.int32(g.U_off),
+                    jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
+                    mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        X = jnp.zeros((sched.n + 1, b.shape[1]), dtype)
+        X = X.at[:sched.n, :].set(b.astype(dtype))
+        for g in sched.groups:
+            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
+                                struct_idx, jnp.int32(g.L_off),
+                                jnp.int32(g.Li_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        for g in reversed(sched.groups):
+            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
+                                struct_idx, jnp.int32(g.U_off),
+                                jnp.int32(g.Ui_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        return X[:sched.n]
+
+    return step

@@ -1,0 +1,130 @@
+"""Dense blocked partial LU without pivoting (device kernel).
+
+The panel-factorization kernel of the TPU build — the analog of
+pdgstrf2_trsm/Local_Dgstrf2 (SRC/pdgstrf2.c:26-98,404) fused with the
+U-row TRSM (pdgstrs2_omp) and the leading Schur update, expressed as a
+blocked right-looking LU of the front's leading wb columns:
+
+    for each NB-wide column block:
+        unblocked rank-1 panel factorization (tiny-pivot replacement,
+        the GESP sqrt(eps)·‖A‖ rule of SRC/pdgstrf2.c)
+        TRSM for the U block row (unit-lower solve)
+        masked GEMM trailing update (runs on the MXU)
+
+Everything is static-shaped: `wb` (padded pivot width) and the front
+size come from the bucket plan, loop bounds are Python ints, and
+row/column masks replace dynamic-size slices so XLA sees one fused
+GEMM per block step.  Identity padding in columns [w, wb) makes the
+padded factorization equal the true one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _tiny_replace(piv, thresh, dtype):
+    """GESP tiny-pivot replacement: |piv| < thresh → sign(piv)·thresh
+    (SRC/pdgstrf2.c; counted into stat->TinyPivots)."""
+    apiv = jnp.abs(piv)
+    is_tiny = apiv < thresh
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        unit = jnp.where(apiv == 0, jnp.ones((), dtype), piv / apiv)
+        newpiv = jnp.where(is_tiny, unit * thresh, piv)
+    else:
+        sgn = jnp.where(piv >= 0, jnp.ones((), dtype), -jnp.ones((), dtype))
+        newpiv = jnp.where(is_tiny, sgn * thresh, piv)
+    return newpiv, is_tiny.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("wb", "nb"))
+def partial_lu(F, thresh, *, wb: int, nb: int = 32):
+    """Factor the leading `wb` columns of the square front F (mb×mb) in
+    place: returns (F', tiny_count) where F' holds L (unit lower, cols
+    < wb), U (upper, rows < wb) and the Schur complement F'[wb:, wb:].
+    `thresh` is the tiny-pivot threshold (0 disables replacement —
+    pass a tiny positive to keep the guard)."""
+    mb = F.shape[-1]
+    dtype = F.dtype
+    nb = min(nb, wb)
+    assert wb % nb == 0, "width buckets must be multiples of the block"
+    rows = jnp.arange(mb)
+
+    def panel_step(t, carry):
+        """Eliminate column k0+t inside the (mb, nb) panel."""
+        panel, k0, tiny = carry
+        k = k0 + t
+        piv = jax.lax.dynamic_index_in_dim(
+            jax.lax.dynamic_index_in_dim(panel, k, axis=0, keepdims=False),
+            t, axis=0, keepdims=False)
+        piv, was_tiny = _tiny_replace(piv, thresh, dtype)
+        col = jax.lax.dynamic_index_in_dim(panel, t, axis=1,
+                                           keepdims=False)
+        below = rows > k
+        scaled = jnp.where(below, col / piv, col)
+        # write back the scaled column and the (possibly replaced) pivot
+        scaled = jnp.where(rows == k, piv, scaled)
+        panel = jax.lax.dynamic_update_index_in_dim(
+            panel, scaled, t, axis=1)
+        # rank-1 update of the panel columns to the right
+        rowvec = jax.lax.dynamic_index_in_dim(panel, k, axis=0,
+                                              keepdims=False)
+        colmask = jnp.arange(panel.shape[1]) > t
+        upd = jnp.outer(jnp.where(below, scaled, 0),
+                        jnp.where(colmask, rowvec, 0))
+        panel = panel - upd
+        return panel, k0, tiny + was_tiny
+
+    def block_step(kb, carry):
+        F, tiny = carry
+        k0 = kb * nb
+        panel = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
+        panel, _, tiny = jax.lax.fori_loop(
+            0, nb, panel_step, (panel, k0, tiny))
+        F = jax.lax.dynamic_update_slice(F, panel, (0, k0))
+        # TRSM: U block row — unit-lower solve of L11 against the full
+        # row slice, merged back only for columns ≥ k0+nb
+        L11 = jax.lax.dynamic_slice(F, (k0, k0), (nb, nb))
+        R = jax.lax.dynamic_slice(F, (k0, 0), (nb, mb))
+        X = jax.lax.linalg.triangular_solve(
+            L11, R, left_side=True, lower=True, unit_diagonal=True)
+        keep = (jnp.arange(mb) >= k0 + nb)[None, :]
+        R2 = jnp.where(keep, X, R)
+        F = jax.lax.dynamic_update_slice(F, R2, (k0, 0))
+        # trailing GEMM: F -= Lcol·Urow restricted to i,j ≥ k0+nb via
+        # masking (zero rows/cols contribute nothing)
+        Lcol = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
+        Lcol = jnp.where((rows >= k0 + nb)[:, None], Lcol, 0)
+        Urow = jnp.where(keep, R2, 0)
+        F = F - Lcol @ Urow
+        return F, tiny
+
+    tiny0 = jnp.zeros((), jnp.int32)
+    F, tiny = jax.lax.fori_loop(0, wb // nb, block_step, (F, tiny0))
+    return F, tiny
+
+
+def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
+    """vmapped partial_lu over a batch of fronts (N, mb, mb)."""
+    f = functools.partial(partial_lu, wb=wb, nb=nb)
+    Fs, tinys = jax.vmap(lambda x: f(x, thresh))(F)
+    return Fs, jnp.sum(tinys)
+
+
+def unit_lower_inverse(L):
+    """inv(L) for batched unit-lower (N, w, w) — the DiagInv
+    preparation (SRC/pdgssvx.c:1436-1447): turns the solve's TRSV into
+    GEMM."""
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    return jax.lax.linalg.triangular_solve(
+        L, eye, left_side=True, lower=True, unit_diagonal=True)
+
+
+def upper_inverse(U):
+    """inv(U) for batched upper-triangular (N, w, w)."""
+    eye = jnp.broadcast_to(jnp.eye(U.shape[-1], dtype=U.dtype), U.shape)
+    return jax.lax.linalg.triangular_solve(
+        U, eye, left_side=True, lower=False, unit_diagonal=False)

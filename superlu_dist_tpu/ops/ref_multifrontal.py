@@ -71,7 +71,11 @@ def factorize_host(plan: FactorPlan, scaled_vals: np.ndarray,
         for k in range(w):
             piv = F[k, k]
             if replace and np.abs(piv) < thresh:
-                piv = thresh if (np.real(piv) >= 0) else -thresh
+                # preserve the pivot's phase (matches the device kernel
+                # _tiny_replace so host stays an exact oracle)
+                apiv = np.abs(piv)
+                piv = (piv / apiv) * thresh if apiv > 0 else \
+                    np.asarray(thresh, dtype=dtype)
                 F[k, k] = piv
                 tiny += 1
             elif piv == 0:

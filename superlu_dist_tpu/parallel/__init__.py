@@ -1,0 +1,3 @@
+from .grid import Grid, Grid3D, make_solver_mesh
+
+__all__ = ["Grid", "Grid3D", "make_solver_mesh"]

@@ -1,0 +1,64 @@
+"""Process-grid analogs over jax device meshes.
+
+The reference's 2D/3D MPI grids (superlu_gridinit, SRC/superlu_grid.c:37;
+superlu_gridinit3d, SRC/superlu_grid3d.c:16) become named
+`jax.sharding.Mesh` axes.  The reference's row/column scoped
+subcommunicators (rscp/cscp) and Z scope (zscp) map to mesh axis names:
+collectives ride ICI along an axis instead of MPI point-to-point over a
+communicator (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass
+class Grid:
+    """2D Pr×Pc grid (gridinfo_t analog).  Axis names follow the
+    reference's scopes: 'r' = row dimension (cscp collectives run along
+    it), 'c' = column dimension (rscp)."""
+    mesh: Mesh
+    nprow: int
+    npcol: int
+
+    @property
+    def nprocs(self) -> int:
+        return self.nprow * self.npcol
+
+
+@dataclasses.dataclass
+class Grid3D:
+    """3D Pr×Pc×Pz grid (gridinfo3d_t analog); 'z' is the
+    communication-avoiding replication axis (ancestor reductions =
+    psum over 'z')."""
+    mesh: Mesh
+    nprow: int
+    npcol: int
+    npdep: int
+
+    @property
+    def grid2d(self) -> Grid:
+        return Grid(mesh=self.mesh, nprow=self.nprow, npcol=self.npcol)
+
+
+def make_solver_mesh(nprow: int = 1, npcol: int = 1, npdep: int = 1,
+                     devices=None):
+    """superlu_gridinit(3d) analog: carve a (Pr, Pc, Pz) mesh out of
+    the available devices (column-major rank order like the
+    reference's default)."""
+    devices = devices if devices is not None else jax.devices()
+    need = nprow * npcol * npdep
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for a {nprow}x{npcol}x{npdep} grid, "
+            f"have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(nprow, npcol, npdep)
+    mesh = Mesh(arr, axis_names=("r", "c", "z"))
+    if npdep == 1:
+        return Grid(mesh=mesh, nprow=nprow, npcol=npcol)
+    return Grid3D(mesh=mesh, nprow=nprow, npcol=npcol, npdep=npdep)

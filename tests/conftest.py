@@ -1,12 +1,20 @@
 """Test configuration: force an 8-virtual-device CPU platform so mesh
-sharding tests run anywhere (the reference's analog is the
-oversubscribed-local-MPI-ranks CTest sweep, TEST/CMakeLists.txt:48-53).
-Must run before jax initializes."""
+sharding tests run anywhere and never grab the real TPU chip (the
+reference's analog is the oversubscribed-local-MPI-ranks CTest sweep,
+TEST/CMakeLists.txt:48-53).
+
+The ambient environment may pre-import jax and register a TPU platform
+via sitecustomize, so plain env vars are too late — use jax.config
+before any backend is initialized."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

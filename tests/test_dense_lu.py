@@ -1,0 +1,83 @@
+"""Device partial-LU kernel vs numpy oracle."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from superlu_dist_tpu.ops.dense_lu import (partial_lu, partial_lu_batch,
+                                           unit_lower_inverse,
+                                           upper_inverse)
+
+
+def np_partial_lu(F, wb):
+    F = F.copy()
+    for k in range(wb):
+        F[k + 1:, k] /= F[k, k]
+        F[k + 1:, k + 1:] -= np.outer(F[k + 1:, k], F[k, k + 1:])
+    return F
+
+
+@pytest.mark.parametrize("mb,wb", [(8, 8), (32, 16), (48, 32), (96, 64)])
+def test_partial_lu_matches_numpy(mb, wb):
+    rng = np.random.default_rng(0)
+    F = rng.standard_normal((mb, mb)) + mb * np.eye(mb)
+    ref = np_partial_lu(F, wb)
+    out, tiny = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=min(wb, 32))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-10,
+                               atol=1e-10)
+    assert int(tiny) == 0
+
+
+def test_partial_lu_identity_padding():
+    """Padding columns with identity diagonal must not change the true
+    block's factors."""
+    rng = np.random.default_rng(1)
+    w, wb, m, mb = 5, 8, 12, 16
+    F = np.zeros((mb, mb))
+    A = rng.standard_normal((m, m)) + m * np.eye(m)
+    # true block occupies [0:w] and [wb:wb+(m-w)]
+    idx = np.concatenate([np.arange(w), wb + np.arange(m - w)])
+    F[np.ix_(idx, idx)] = A
+    for t in range(w, wb):
+        F[t, t] = 1.0
+    ref = np_partial_lu(A, w)
+    out, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
+    out = np.asarray(out)
+    np.testing.assert_allclose(out[np.ix_(idx, idx)], ref, rtol=1e-10,
+                               atol=1e-10)
+
+
+def test_tiny_pivot_replacement():
+    F = np.array([[1e-30, 1.0], [1.0, 1.0]])
+    out, tiny = partial_lu(jnp.asarray(F), 1e-8, wb=2, nb=2)
+    assert int(tiny) == 1
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_batch_and_inverses():
+    rng = np.random.default_rng(2)
+    B, mb, wb = 4, 32, 16
+    F = rng.standard_normal((B, mb, mb)) + mb * np.eye(mb)
+    out, tiny = partial_lu_batch(jnp.asarray(F), 0.0, wb=wb, nb=16)
+    out = np.asarray(out)
+    for i in range(B):
+        ref = np_partial_lu(F[i], wb)
+        np.testing.assert_allclose(out[i], ref, rtol=1e-9, atol=1e-9)
+    L11 = np.tril(out[:, :wb, :wb], -1) + np.eye(wb)
+    U11 = np.triu(out[:, :wb, :wb])
+    Li = np.asarray(unit_lower_inverse(jnp.asarray(L11)))
+    Ui = np.asarray(upper_inverse(jnp.asarray(U11)))
+    for i in range(B):
+        np.testing.assert_allclose(Li[i] @ L11[i], np.eye(wb), atol=1e-9)
+        np.testing.assert_allclose(Ui[i] @ U11[i], np.eye(wb), atol=1e-9)
+
+
+def test_complex_dtype():
+    rng = np.random.default_rng(3)
+    mb, wb = 16, 8
+    F = (rng.standard_normal((mb, mb)) + 1j * rng.standard_normal((mb, mb))
+         + mb * np.eye(mb)).astype(np.complex128)
+    ref = np_partial_lu(F, wb)
+    out, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-10, atol=1e-10)
