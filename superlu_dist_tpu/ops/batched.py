@@ -92,12 +92,15 @@ class GroupSpec:
     U_off: int
     Li_off: int
     Ui_off: int
-    _dev: Optional[Tuple] = None  # lazy device-array cache
+    _dev: Optional[dict] = None  # lazy device-array cache, keyed by squeeze
 
     def dev(self, squeeze: bool):
-        """Device copies of the index arrays (cached).  squeeze=True
-        drops the leading ndev=1 axis for the single-device path."""
+        """Device copies of the index arrays (cached per `squeeze`).
+        squeeze=True drops the leading ndev=1 axis for the
+        single-device path."""
         if self._dev is None:
+            self._dev = {}
+        if squeeze not in self._dev:
             f_loc = self.n_loc * self.mb * self.mb
             fdt = jnp.int32 if f_loc < 2**31 - 1 else jnp.int64
             sdt = (jnp.int32 if int(self.a_src.max(initial=0)) < 2**31 - 1
@@ -115,8 +118,8 @@ class GroupSpec:
             )
             if squeeze:
                 arrs = tuple(a[0] for a in arrs)
-            self._dev = arrs
-        return self._dev
+            self._dev[squeeze] = arrs
+        return self._dev[squeeze]
 
 
 @dataclasses.dataclass
@@ -261,13 +264,18 @@ def _real_dtype(dtype: np.dtype):
 
 
 # --------------------------------------------------------------------
-# per-group bodies (shared by single-device jit and shard_map paths)
+# per-group bodies — ONE implementation serves the single-device jit
+# path (axis=None) and the shard_map distributed path (axis='z'): the
+# only differences are the all_gather propagating the update slab and
+# the psum-of-deltas solve updates, so keeping a single body guarantees
+# the oracle and the distributed path cannot diverge.
 # --------------------------------------------------------------------
 
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
-                       tiny, thresh, a_src, a_dst, one_dst, ea_src,
-                       ea_dst, upd_off, L_off, U_off, Li_off, Ui_off,
-                       *, mb: int, wb: int, n_pad: int):
+                       tiny, nzero, thresh, a_src, a_dst, one_dst,
+                       ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
+                       Ui_off, *, mb: int, wb: int, n_pad: int,
+                       axis: Optional[str] = None):
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     F = jnp.zeros(n_pad * mb * mb, dtype)
@@ -276,7 +284,7 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
     F = F.reshape(n_pad, mb, mb)
 
-    F, tiny_g = partial_lu_batch(F, thresh, wb=wb)
+    F, tiny_g, nzero_g = partial_lu_batch(F, thresh, wb=wb)
 
     rows = jnp.arange(mb)[:, None]
     colsw = jnp.arange(wb)[None, :]
@@ -295,54 +303,76 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     Ui_flat = jax.lax.dynamic_update_slice(Ui_flat, Ui.reshape(-1),
                                            (Ui_off,))
     if mb > wb:
-        upd = F[:, wb:, wb:]
-        upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd.reshape(-1),
-                                               (upd_off,))
-    return upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny + tiny_g
+        upd = F[:, wb:, wb:].reshape(-1)
+        if axis is not None:
+            # ancestor propagation: the reference's dreduceAncestors3d /
+            # Z-axis panel exchange becomes one tiled all_gather along
+            # the mesh axis — device-major local slabs concatenate into
+            # exactly the global slab layout
+            upd = jax.lax.all_gather(upd, axis, tiled=True)
+        upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd, (upd_off,))
+    return (upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
+            tiny + tiny_g, nzero + nzero_g)
 
 
 _factor_group = functools.partial(
     jax.jit,
-    static_argnames=("mb", "wb", "n_pad"),
+    static_argnames=("mb", "wb", "n_pad", "axis"),
     donate_argnames=("upd_buf", "L_flat", "U_flat", "Li_flat",
                      "Ui_flat"))(_factor_group_impl)
 
 
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
-                    Li_off, *, mb: int, wb: int, n_pad: int):
+                    Li_off, *, mb: int, wb: int, n_pad: int,
+                    axis: Optional[str] = None):
     xb = X[col_idx]                                     # (Np, wb, nrhs)
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     y = Li @ xb
-    X = X.at[col_idx].set(y)
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-        X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
-    return X
+    if axis is None:
+        X = X.at[col_idx].set(y)
+        if mb > wb:
+            X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
+        return X
+    # distributed: each device owns a disjoint set of fronts, so the
+    # psum of disjoint deltas is the C_Tree reduce forest of pdgstrs
+    # (SRC/pdgstrs.c:2133-2139) collapsed into one collective
+    delta = jnp.zeros_like(X).at[col_idx].add(y - xb)
+    if mb > wb:
+        delta = delta.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
+    return X + jax.lax.psum(delta, axis)
 
 
 _fwd_group = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad"),
+    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
     donate_argnames=("X",))(_fwd_group_impl)
 
 
 def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
-                    Ui_off, *, mb: int, wb: int, n_pad: int):
+                    Ui_off, *, mb: int, wb: int, n_pad: int,
+                    axis: Optional[str] = None):
     xb = X[col_idx]
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
         xs = X[struct_idx]
-        xb = xb - Up[:, :, wb:] @ xs
+        rhs = xb - Up[:, :, wb:] @ xs
+    else:
+        rhs = xb
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
-    X = X.at[col_idx].set(Ui @ xb)
-    return X
+    x1 = Ui @ rhs
+    if axis is None:
+        return X.at[col_idx].set(x1)
+    delta = jnp.zeros_like(X).at[col_idx].add(x1 - xb)
+    return X + jax.lax.psum(delta, axis)
 
 
 _bwd_group = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad"),
+    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
     donate_argnames=("X",))(_bwd_group_impl)
 
 
@@ -379,16 +409,26 @@ def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
     Li_flat = jnp.zeros(sched.Li_total, dtype)
     Ui_flat = jnp.zeros(sched.Ui_total, dtype)
     tiny = jnp.zeros((), jnp.int32)
+    nzero = jnp.zeros((), jnp.int32)
 
     for g in sched.groups:
         a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = g.dev(squeeze=True)
-        upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny = _factor_group(
+        (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+         nzero) = _factor_group(
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
-            thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
+            nzero, thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
             jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
             jnp.int32(g.U_off), jnp.int32(g.Li_off),
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc)
 
+    if int(nzero) > 0:
+        # reference semantics: U(i,i) == 0 with ReplaceTinyPivot=NO is
+        # the info=i singularity signal (SRC/pdgstrf.c header); the
+        # host backend raises for the same input
+        raise ZeroDivisionError(
+            f"factorization hit {int(nzero)} exactly-zero pivot(s); "
+            "the matrix is singular (enable replace_tiny_pivot to "
+            "perturb instead)")
     return DeviceLU(plan=plan, schedule=sched, dtype=dtype,
                     L_flat=L_flat, U_flat=U_flat,
                     Li_flat=Li_flat, Ui_flat=Ui_flat,
@@ -449,19 +489,23 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         Li_flat = jnp.zeros(sched.Li_total, dtype)
         Ui_flat = jnp.zeros(sched.Ui_total, dtype)
         tiny = jnp.zeros((), jnp.int32)
+        nzero = jnp.zeros((), jnp.int32)
         for g in sched.groups:
             a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = \
                 g.dev(squeeze=True)
-            upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny = \
-                _factor_group_impl(
+            (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+             nzero) = _factor_group_impl(
                     vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
-                    tiny, thresh, a_src, a_dst, one_dst, ea_src,
+                    tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
                     ea_dst, jnp.int32(g.upd_off_global),
                     jnp.int32(g.L_off), jnp.int32(g.U_off),
                     jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
                     mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-        X = jnp.zeros((sched.n + 1, b.shape[1]), dtype)
-        X = X.at[:sched.n, :].set(b.astype(dtype))
+        # promote rather than cast: a complex rhs against a real
+        # factor must stay complex (matches solve_device)
+        xdt = jnp.promote_types(dtype, b.dtype)
+        X = jnp.zeros((sched.n + 1, b.shape[1]), xdt)
+        X = X.at[:sched.n, :].set(b.astype(xdt))
         for g in sched.groups:
             _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
             X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,

@@ -28,7 +28,10 @@ import jax.numpy as jnp
 
 def _tiny_replace(piv, thresh, dtype):
     """GESP tiny-pivot replacement: |piv| < thresh → sign(piv)·thresh
-    (SRC/pdgstrf2.c; counted into stat->TinyPivots)."""
+    (SRC/pdgstrf2.c; counted into stat->TinyPivots).  Also flags an
+    exactly-zero pivot that was NOT replaced (thresh == 0, i.e.
+    ReplaceTinyPivot=NO) — the reference's info=k singularity signal
+    (SRC/pdgstrf.c header)."""
     apiv = jnp.abs(piv)
     is_tiny = apiv < thresh
     if jnp.issubdtype(dtype, jnp.complexfloating):
@@ -37,7 +40,8 @@ def _tiny_replace(piv, thresh, dtype):
     else:
         sgn = jnp.where(piv >= 0, jnp.ones((), dtype), -jnp.ones((), dtype))
         newpiv = jnp.where(is_tiny, sgn * thresh, piv)
-    return newpiv, is_tiny.astype(jnp.int32)
+    was_zero = jnp.logical_and(apiv == 0, jnp.logical_not(is_tiny))
+    return newpiv, is_tiny.astype(jnp.int32), was_zero.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("wb", "nb"))
@@ -55,12 +59,12 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
 
     def panel_step(t, carry):
         """Eliminate column k0+t inside the (mb, nb) panel."""
-        panel, k0, tiny = carry
+        panel, k0, tiny, nzero = carry
         k = k0 + t
         piv = jax.lax.dynamic_index_in_dim(
             jax.lax.dynamic_index_in_dim(panel, k, axis=0, keepdims=False),
             t, axis=0, keepdims=False)
-        piv, was_tiny = _tiny_replace(piv, thresh, dtype)
+        piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
         col = jax.lax.dynamic_index_in_dim(panel, t, axis=1,
                                            keepdims=False)
         below = rows > k
@@ -76,14 +80,14 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
         upd = jnp.outer(jnp.where(below, scaled, 0),
                         jnp.where(colmask, rowvec, 0))
         panel = panel - upd
-        return panel, k0, tiny + was_tiny
+        return panel, k0, tiny + was_tiny, nzero + was_zero
 
     def block_step(kb, carry):
-        F, tiny = carry
+        F, tiny, nzero = carry
         k0 = kb * nb
         panel = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
-        panel, _, tiny = jax.lax.fori_loop(
-            0, nb, panel_step, (panel, k0, tiny))
+        panel, _, tiny, nzero = jax.lax.fori_loop(
+            0, nb, panel_step, (panel, k0, tiny, nzero))
         F = jax.lax.dynamic_update_slice(F, panel, (0, k0))
         # TRSM: U block row — unit-lower solve of L11 against the full
         # row slice, merged back only for columns ≥ k0+nb
@@ -100,18 +104,20 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
         Lcol = jnp.where((rows >= k0 + nb)[:, None], Lcol, 0)
         Urow = jnp.where(keep, R2, 0)
         F = F - Lcol @ Urow
-        return F, tiny
+        return F, tiny, nzero
 
     tiny0 = jnp.zeros((), jnp.int32)
-    F, tiny = jax.lax.fori_loop(0, wb // nb, block_step, (F, tiny0))
-    return F, tiny
+    F, tiny, nzero = jax.lax.fori_loop(
+        0, wb // nb, block_step, (F, tiny0, tiny0))
+    return F, tiny, nzero
 
 
 def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
-    """vmapped partial_lu over a batch of fronts (N, mb, mb)."""
+    """vmapped partial_lu over a batch of fronts (N, mb, mb).
+    Returns (F', tiny_count, zero_pivot_count)."""
     f = functools.partial(partial_lu, wb=wb, nb=nb)
-    Fs, tinys = jax.vmap(lambda x: f(x, thresh))(F)
-    return Fs, jnp.sum(tinys)
+    Fs, tinys, nzeros = jax.vmap(lambda x: f(x, thresh))(F)
+    return Fs, jnp.sum(tinys), jnp.sum(nzeros)
 
 
 def unit_lower_inverse(L):

@@ -22,6 +22,10 @@ compiled program:
   * factor panels stay device-resident and device-sharded (the
     dLocalLU_t distribution, SRC/superlu_ddefs.h:97-263).
 
+The per-group bodies are literally ops.batched's `_factor_group_impl` /
+`_fwd_group_impl` / `_bwd_group_impl` with `axis='z'` — one
+implementation serves both execution modes by construction.
+
 Everything is shard_map'd over `Mesh(axis='z')`, so the same program
 runs on 1 device (degenerate), an 8-device CPU mesh (tests), or a TPU
 pod slice (ICI collectives).
@@ -35,96 +39,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..plan.plan import FactorPlan
-from ..ops.batched import (GroupSpec, _bwd_group_impl, _real_dtype,
-                           _thresh_for, get_schedule)
-from ..ops.dense_lu import (partial_lu_batch, unit_lower_inverse,
-                            upper_inverse)
-
-
-def _factor_group_local(vals, upd_buf, flats, tiny, thresh,
-                        g: GroupSpec, idx):
-    """Per-device body for one level/bucket group (inside shard_map;
-    `idx` holds this device's slices of the index arrays).  Mirrors
-    ops.batched._factor_group_impl but propagates the update slab with
-    a tiled all_gather instead of a local slice write."""
-    L_flat, U_flat, Li_flat, Ui_flat = flats
-    mb, wb, n_loc = g.mb, g.wb, g.n_loc
-    dtype = L_flat.dtype
-    one = jnp.ones((), dtype)
-    a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = idx
-
-    F = jnp.zeros(n_loc * mb * mb, dtype)
-    F = F.at[a_dst].add(vals[a_src], mode="drop")
-    F = F.at[one_dst].set(one, mode="drop")
-    F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
-    F = F.reshape(n_loc, mb, mb)
-
-    F, tiny_g = partial_lu_batch(F, thresh, wb=wb)
-
-    rows = jnp.arange(mb)[:, None]
-    colsw = jnp.arange(wb)[None, :]
-    Lpanel = jnp.where(rows > colsw, F[:, :, :wb],
-                       jnp.where(rows == colsw, one, 0))
-    Upanel = jnp.where(colsw.T <= jnp.arange(mb)[None, :], F[:, :wb, :], 0)
-    Li = unit_lower_inverse(Lpanel[:, :wb, :])
-    Ui = upper_inverse(Upanel[:, :, :wb])
-
-    L_flat = jax.lax.dynamic_update_slice(
-        L_flat, Lpanel.reshape(-1), (jnp.int32(g.L_off),))
-    U_flat = jax.lax.dynamic_update_slice(
-        U_flat, Upanel.reshape(-1), (jnp.int32(g.U_off),))
-    Li_flat = jax.lax.dynamic_update_slice(
-        Li_flat, Li.reshape(-1), (jnp.int32(g.Li_off),))
-    Ui_flat = jax.lax.dynamic_update_slice(
-        Ui_flat, Ui.reshape(-1), (jnp.int32(g.Ui_off),))
-
-    if mb > wb:
-        upd_loc = F[:, wb:, wb:].reshape(-1)
-        # ancestor propagation: the reference's dreduceAncestors3d /
-        # Z-axis panel exchange becomes one tiled all_gather along the
-        # mesh axis — local slabs concatenate into the global slab
-        upd_slab = jax.lax.all_gather(upd_loc, "z", tiled=True)
-        upd_buf = jax.lax.dynamic_update_slice(
-            upd_buf, upd_slab, (jnp.int32(g.upd_off_global),))
-    return upd_buf, (L_flat, U_flat, Li_flat, Ui_flat), tiny + tiny_g
-
-
-def _fwd_group_local(X, L_flat, Li_flat, g: GroupSpec, col_idx,
-                     struct_idx):
-    mb, wb, n_loc = g.mb, g.wb, g.n_loc
-    xb = X[col_idx]                                   # (n_loc, wb, nrhs)
-    Li = jax.lax.dynamic_slice(
-        Li_flat, (jnp.int32(g.Li_off),),
-        (n_loc * wb * wb,)).reshape(n_loc, wb, wb)
-    y = Li @ xb
-    delta = jnp.zeros_like(X).at[col_idx].add(y - xb)
-    if mb > wb:
-        Lp = jax.lax.dynamic_slice(
-            L_flat, (jnp.int32(g.L_off),),
-            (n_loc * mb * wb,)).reshape(n_loc, mb, wb)
-        delta = delta.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
-    # disjoint ownership: psum is the C_Tree reduce forest collapsed
-    return X + jax.lax.psum(delta, "z")
-
-
-def _bwd_group_local(X, U_flat, Ui_flat, g: GroupSpec, col_idx,
-                     struct_idx):
-    mb, wb, n_loc = g.mb, g.wb, g.n_loc
-    xb = X[col_idx]
-    if mb > wb:
-        Up = jax.lax.dynamic_slice(
-            U_flat, (jnp.int32(g.U_off),),
-            (n_loc * wb * mb,)).reshape(n_loc, wb, mb)
-        xs = X[struct_idx]
-        rhs = xb - Up[:, :, wb:] @ xs
-    else:
-        rhs = xb
-    Ui = jax.lax.dynamic_slice(
-        Ui_flat, (jnp.int32(g.Ui_off),),
-        (n_loc * wb * wb,)).reshape(n_loc, wb, wb)
-    x1 = Ui @ rhs
-    delta = jnp.zeros_like(X).at[col_idx].add(x1 - xb)
-    return X + jax.lax.psum(delta, "z")
+from ..ops.batched import (_bwd_group_impl, _factor_group_impl,
+                           _fwd_group_impl, _real_dtype, _thresh_for,
+                           get_schedule)
 
 
 def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
@@ -151,23 +68,37 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         vals = jnp.concatenate([vals.astype(dtype),
                                 jnp.zeros(1, dtype)])
         upd_buf = jnp.zeros(dsched.upd_total + 1, dtype)
-        flats = (jnp.zeros(dsched.L_total, dtype),
-                 jnp.zeros(dsched.U_total, dtype),
-                 jnp.zeros(dsched.Li_total, dtype),
-                 jnp.zeros(dsched.Ui_total, dtype))
+        L_flat = jnp.zeros(dsched.L_total, dtype)
+        U_flat = jnp.zeros(dsched.U_total, dtype)
+        Li_flat = jnp.zeros(dsched.Li_total, dtype)
+        Ui_flat = jnp.zeros(dsched.Ui_total, dtype)
         tiny = jnp.zeros((), jnp.int32)
+        nzero = jnp.zeros((), jnp.int32)
         for g, idx in zip(dsched.groups, per_group):
-            upd_buf, flats, tiny = _factor_group_local(
-                vals, upd_buf, flats, tiny, thresh, g, idx)
-        L_flat, U_flat, Li_flat, Ui_flat = flats
+            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = idx
+            (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+             nzero) = _factor_group_impl(
+                vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
+                tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
+                ea_dst, jnp.int32(g.upd_off_global),
+                jnp.int32(g.L_off), jnp.int32(g.U_off),
+                jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
+                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
 
-        X = jnp.zeros((n + 1, b.shape[1]), dtype)
-        X = X.at[:n, :].set(b.astype(dtype))
+        xdt = jnp.promote_types(dtype, b.dtype)
+        X = jnp.zeros((n + 1, b.shape[1]), xdt)
+        X = X.at[:n, :].set(b.astype(xdt))
         for g, idx in zip(dsched.groups, per_group):
-            X = _fwd_group_local(X, L_flat, Li_flat, g, idx[5], idx[6])
+            X = _fwd_group_impl(
+                X, L_flat, Li_flat, idx[5], idx[6],
+                jnp.int32(g.L_off), jnp.int32(g.Li_off),
+                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
         for g, idx in zip(reversed(dsched.groups),
                           reversed(per_group)):
-            X = _bwd_group_local(X, U_flat, Ui_flat, g, idx[5], idx[6])
+            X = _bwd_group_impl(
+                X, U_flat, Ui_flat, idx[5], idx[6],
+                jnp.int32(g.U_off), jnp.int32(g.Ui_off),
+                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
         return X[:n]
 
     idx_specs = tuple(P(axis) for _ in dsched.groups for _ in range(7))

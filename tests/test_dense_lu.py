@@ -23,7 +23,7 @@ def test_partial_lu_matches_numpy(mb, wb):
     rng = np.random.default_rng(0)
     F = rng.standard_normal((mb, mb)) + mb * np.eye(mb)
     ref = np_partial_lu(F, wb)
-    out, tiny = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=min(wb, 32))
+    out, tiny, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=min(wb, 32))
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-10,
                                atol=1e-10)
     assert int(tiny) == 0
@@ -42,7 +42,7 @@ def test_partial_lu_identity_padding():
     for t in range(w, wb):
         F[t, t] = 1.0
     ref = np_partial_lu(A, w)
-    out, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
+    out, _, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
     out = np.asarray(out)
     np.testing.assert_allclose(out[np.ix_(idx, idx)], ref, rtol=1e-10,
                                atol=1e-10)
@@ -50,7 +50,7 @@ def test_partial_lu_identity_padding():
 
 def test_tiny_pivot_replacement():
     F = np.array([[1e-30, 1.0], [1.0, 1.0]])
-    out, tiny = partial_lu(jnp.asarray(F), 1e-8, wb=2, nb=2)
+    out, tiny, _ = partial_lu(jnp.asarray(F), 1e-8, wb=2, nb=2)
     assert int(tiny) == 1
     assert np.isfinite(np.asarray(out)).all()
 
@@ -59,7 +59,7 @@ def test_batch_and_inverses():
     rng = np.random.default_rng(2)
     B, mb, wb = 4, 32, 16
     F = rng.standard_normal((B, mb, mb)) + mb * np.eye(mb)
-    out, tiny = partial_lu_batch(jnp.asarray(F), 0.0, wb=wb, nb=16)
+    out, tiny, _ = partial_lu_batch(jnp.asarray(F), 0.0, wb=wb, nb=16)
     out = np.asarray(out)
     for i in range(B):
         ref = np_partial_lu(F[i], wb)
@@ -79,5 +79,5 @@ def test_complex_dtype():
     F = (rng.standard_normal((mb, mb)) + 1j * rng.standard_normal((mb, mb))
          + mb * np.eye(mb)).astype(np.complex128)
     ref = np_partial_lu(F, wb)
-    out, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
+    out, _, _ = partial_lu(jnp.asarray(F), 0.0, wb=wb, nb=8)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-10, atol=1e-10)
