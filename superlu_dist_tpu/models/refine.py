@@ -12,21 +12,30 @@ from __future__ import annotations
 import numpy as np
 
 
-def _refine_dtype(opts):
+def _refine_dtype(opts, a_dtype):
     """SLU_SINGLE accumulates residuals in the working (factor)
     precision; SLU_DOUBLE in refine_dtype (f64 by default) — the
-    psgsrfs vs psgsrfs_d2 distinction."""
+    psgsrfs vs psgsrfs_d2 distinction.  A complex system promotes the
+    accumulator to the matching complex dtype (refine_dtype names the
+    *precision*, the matrix decides realness — the reference's z twin
+    files hard-code doublecomplex here)."""
     from ..options import IterRefine
     if opts.iter_refine == IterRefine.SLU_SINGLE:
-        return np.dtype(opts.factor_dtype)
-    return np.dtype(opts.refine_dtype)
+        base = np.dtype(opts.factor_dtype)
+    else:
+        base = np.dtype(opts.refine_dtype)
+    if np.issubdtype(np.dtype(a_dtype), np.complexfloating):
+        # lift realness only — promote_types(f32, c64)=c64 keeps the
+        # working precision, unlike promoting with a_dtype directly
+        base = np.promote_types(base, np.complex64)
+    return base
 
 
 def _operands(lu):
     """A and |A| in refine precision, cached on the factorization
     handle (the FACTORED rung exists for repeated solves; rebuilding
     these per solve would be an O(nnz) tax on every call)."""
-    rdt = _refine_dtype(lu.effective_options)
+    rdt = _refine_dtype(lu.effective_options, lu.a.dtype)
     cache = lu.refine_cache
     if cache is None or cache.get("dtype") != rdt:
         asp = lu.a.to_scipy().astype(rdt)
@@ -38,7 +47,7 @@ def _operands(lu):
 def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
                      from_factor_sol):
     opts = lu.effective_options
-    rdt = _refine_dtype(opts)
+    rdt = _refine_dtype(opts, lu.a.dtype)
     eps = np.finfo(rdt).eps
     asp, abs_a = _operands(lu)
     xk = x.astype(rdt)

@@ -54,6 +54,16 @@ def convection_diffusion_2d(k: int, wind: float = 20.0,
     return csr_from_scipy(a.astype(dtype))
 
 
+def helmholtz_2d(k: int, shift: complex = 0.5 + 0.5j,
+                 dtype=np.complex128) -> CSRMatrix:
+    """Complex shifted 2D Laplacian (Helmholtz-type), the canonical
+    complex test problem — analog of the reference's z-precision
+    inputs (EXAMPLE/cg20.cua)."""
+    t = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(k, k))
+    a = (sp.kronsum(t, t) - shift * sp.eye(k * k)).tocsr().astype(dtype)
+    return csr_from_scipy(a)
+
+
 def manufactured_rhs(a: CSRMatrix, nrhs: int = 1, seed: int = 1):
     """RHS with known solution (dGenXtrue_dist/dFillRHS_dist analog,
     EXAMPLE/pddrive.c)."""
