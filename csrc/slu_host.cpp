@@ -1,0 +1,484 @@
+// Native host-side graph algorithms for the TPU sparse direct solver.
+//
+// C++ implementations of the sequential preprocessing passes that the
+// reference implements in C (per-function citations below), exposed
+// through a minimal C ABI consumed via ctypes
+// (superlu_dist_tpu/utils/native.py).  The Python versions in
+// superlu_dist_tpu/plan/ remain the portable fallback and the test
+// oracle (tests/test_native.py compares the two).
+//
+//   slu_etree      — elimination tree        (reference SRC/etree.c)
+//   slu_postorder  — forest postorder        (reference SRC/etree.c)
+//   slu_colcounts  — Cholesky column counts  (reference SRC/symbfact.c:81
+//                    derives the same quantity while factorizing)
+//   slu_mdorder    — minimum-degree ordering (reference SRC/mmd.c genmmd)
+//   slu_mc64       — static-pivoting row permutation, max product of
+//                    diagonal magnitudes with dual-variable scalings
+//                    (reference SRC/mc64ad_dist.c:121, job=5)
+//   slu_symbfact_* — supernodal symbolic factorization on the
+//                    symmetrized pattern (reference SRC/symbfact.c:81)
+//
+// All index arrays are int64 (the reference's _LONGINT / XSDK_INDEX_SIZE
+// 64 mode, SRC/superlu_defs.h).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <queue>
+#include <vector>
+
+using std::int64_t;
+
+extern "C" {
+
+// ---------------------------------------------------------------- etree
+// Liu's algorithm with path compression on the symmetric pattern
+// (indptr/indices CSR; only i<j pairs are used).
+void slu_etree(int64_t n, const int64_t* indptr, const int64_t* indices,
+               int64_t* parent) {
+  std::vector<int64_t> ancestor(n, -1);
+  for (int64_t j = 0; j < n; ++j) parent[j] = -1;
+  for (int64_t j = 0; j < n; ++j) {
+    for (int64_t p = indptr[j]; p < indptr[j + 1]; ++p) {
+      int64_t i = indices[p];
+      if (i >= j) continue;
+      int64_t r = i;
+      while (true) {
+        int64_t a = ancestor[r];
+        if (a == j) break;
+        ancestor[r] = j;
+        if (a == -1) { parent[r] = j; break; }
+        r = a;
+      }
+    }
+  }
+}
+
+// ------------------------------------------------------------ postorder
+// Iterative DFS over the forest, children visited in ascending order.
+void slu_postorder(int64_t n, const int64_t* parent, int64_t* post) {
+  std::vector<int64_t> head(n, -1), nxt(n, -1), stack;
+  for (int64_t j = n - 1; j >= 0; --j) {
+    int64_t p = parent[j];
+    if (p != -1) { nxt[j] = head[p]; head[p] = j; }
+  }
+  int64_t k = 0;
+  stack.reserve(64);
+  for (int64_t root = 0; root < n; ++root) {
+    if (parent[root] != -1) continue;
+    stack.push_back(root);
+    while (!stack.empty()) {
+      int64_t node = stack.back();
+      int64_t child = head[node];
+      if (child != -1) {
+        head[node] = nxt[child];
+        stack.push_back(child);
+      } else {
+        post[k++] = node;
+        stack.pop_back();
+      }
+    }
+  }
+}
+
+// ------------------------------------------------------------ colcounts
+// Gilbert–Ng–Peyton skeleton/leaf counting with path-halving LCA on a
+// postordered symmetric pattern (parent[j] > j for non-roots).
+void slu_colcounts(int64_t n, const int64_t* indptr, const int64_t* indices,
+                   const int64_t* parent, int64_t* colcount) {
+  std::vector<int64_t> first(n, -1), maxfirst(n, -1), prevleaf(n, -1),
+      ancestor(n), delta(n, 0);
+  for (int64_t j = 0; j < n; ++j) ancestor[j] = j;
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t j = k;
+    delta[j] = (first[j] == -1) ? 1 : 0;
+    while (j != -1 && first[j] == -1) { first[j] = k; j = parent[j]; }
+  }
+  auto find = [&](int64_t q) {
+    while (ancestor[q] != q) {
+      ancestor[q] = ancestor[ancestor[q]];
+      q = ancestor[q];
+    }
+    return q;
+  };
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t j = k, p = parent[j];
+    if (p != -1) delta[p] -= 1;
+    for (int64_t t = indptr[j]; t < indptr[j + 1]; ++t) {
+      int64_t i = indices[t];
+      if (i <= j) continue;
+      if (first[j] > maxfirst[i]) {
+        delta[j] += 1;
+        maxfirst[i] = first[j];
+        int64_t pl = prevleaf[i];
+        if (pl != -1) delta[find(pl)] -= 1;
+        prevleaf[i] = j;
+      }
+    }
+    if (p != -1) ancestor[j] = p;
+  }
+  for (int64_t j = 0; j < n; ++j) colcount[j] = delta[j];
+  for (int64_t j = 0; j < n; ++j) {
+    int64_t p = parent[j];
+    if (p != -1) colcount[p] += colcount[j];
+  }
+}
+
+// -------------------------------------------------------------- mdorder
+// Quotient-graph minimum degree with exact external degrees,
+// supervariable (mass) elimination and element absorption — the same
+// algorithm family as the reference's genmmd (SRC/mmd.c).  Eliminated
+// pivots become "elements" whose variable lists stand in for the fill
+// clique, so fill edges are never materialized and memory stays O(nnz).
+// `order[k]` = k-th pivot in original labels.  Returns n on success.
+int64_t slu_mdorder(int64_t n, const int64_t* indptr,
+                    const int64_t* indices, int64_t* order) {
+  if (n == 0) return 0;
+  std::vector<std::vector<int64_t>> adj(n), els(n), members(n);
+  for (int64_t j = 0; j < n; ++j) {
+    adj[j].reserve(indptr[j + 1] - indptr[j]);
+    for (int64_t p = indptr[j]; p < indptr[j + 1]; ++p) {
+      int64_t i = indices[p];
+      if (i != j) adj[j].push_back(i);
+    }
+    members[j].push_back(j);
+  }
+  std::vector<std::vector<int64_t>> elem_vars;  // element -> member vars
+  std::vector<int64_t> nv(n, 1);                // supervariable weights
+  std::vector<int64_t> mark(n, -1), degree(n);
+  std::vector<char> dead(n, 0);                 // eliminated or absorbed
+  int64_t stamp = 0;
+
+  // exact weighted external degree of u via marker scan
+  auto exact_degree = [&](int64_t u) -> int64_t {
+    ++stamp;
+    mark[u] = stamp;
+    int64_t deg = 0;
+    for (int64_t w2 : adj[u])
+      if (!dead[w2] && mark[w2] != stamp) { mark[w2] = stamp; deg += nv[w2]; }
+    for (int64_t e : els[u])
+      for (int64_t w2 : elem_vars[e])
+        if (!dead[w2] && mark[w2] != stamp) { mark[w2] = stamp; deg += nv[w2]; }
+    return deg;
+  };
+
+  using HeapItem = std::pair<int64_t, int64_t>;  // (degree, var)
+  std::priority_queue<HeapItem, std::vector<HeapItem>,
+                      std::greater<HeapItem>> heap;
+  for (int64_t j = 0; j < n; ++j) {
+    degree[j] = exact_degree(j);
+    heap.push({degree[j], j});
+  }
+
+  int64_t k = 0;
+  std::vector<int64_t> pivot_nbrs;
+  std::vector<int64_t> absorbed_stamp;  // element -> pivot count when absorbed
+  int64_t pivot_count = 0;
+  while (k < n) {
+    int64_t v = -1;
+    while (!heap.empty()) {
+      auto [d, cand] = heap.top();
+      heap.pop();
+      if (!dead[cand] && d == degree[cand]) { v = cand; break; }
+    }
+    if (v == -1) {  // disconnected stragglers
+      for (int64_t j = 0; j < n; ++j)
+        if (!dead[j]) {
+          dead[j] = 1;
+          for (int64_t m : members[j]) order[k++] = m;
+        }
+      break;
+    }
+
+    // the new element's variable set = v's current neighborhood
+    ++stamp;
+    mark[v] = stamp;
+    pivot_nbrs.clear();
+    for (int64_t w2 : adj[v])
+      if (!dead[w2] && mark[w2] != stamp) {
+        mark[w2] = stamp;
+        pivot_nbrs.push_back(w2);
+      }
+    for (int64_t e : els[v])
+      for (int64_t w2 : elem_vars[e])
+        if (!dead[w2] && w2 != v && mark[w2] != stamp) {
+          mark[w2] = stamp;
+          pivot_nbrs.push_back(w2);
+        }
+
+    int64_t enew = (int64_t)elem_vars.size();
+    elem_vars.push_back(pivot_nbrs);
+    dead[v] = 1;
+    for (int64_t m : members[v]) order[k++] = m;
+
+    // neighbor cleanup: drop covered variable adjacency, absorb v's
+    // elements, attach enew.  mark currently flags members of enew ∪ {v}.
+    ++pivot_count;
+    absorbed_stamp.resize(elem_vars.size(), 0);
+    for (int64_t e : els[v]) absorbed_stamp[e] = pivot_count;
+    for (int64_t u : pivot_nbrs) {
+      auto& au = adj[u];
+      size_t t = 0;
+      for (int64_t w2 : au) {
+        if (dead[w2] || w2 == v) continue;
+        if (mark[w2] == stamp) continue;  // covered by enew
+        au[t++] = w2;
+      }
+      au.resize(t);
+      auto& eu = els[u];
+      size_t te = 0;
+      for (int64_t e : eu)
+        if (absorbed_stamp[e] != pivot_count) eu[te++] = e;
+      eu.resize(te);
+      eu.push_back(enew);
+    }
+    els[v].clear();
+    adj[v].clear();
+
+    // supervariable detection among enew's members: hash adjacency,
+    // verify exactly, merge u2 into u1 (weights and members add)
+    if (pivot_nbrs.size() > 1) {
+      std::vector<std::pair<uint64_t, int64_t>> sig;
+      sig.reserve(pivot_nbrs.size());
+      for (int64_t u : pivot_nbrs) {
+        if (dead[u]) continue;
+        uint64_t h = 1469598103934665603ull;
+        for (int64_t w2 : adj[u])
+          if (!dead[w2]) h += (uint64_t)w2 * 1099511628211ull;
+        std::vector<int64_t> es = els[u];
+        std::sort(es.begin(), es.end());
+        for (int64_t e : es)
+          h ^= ((uint64_t)e + 0x9e3779b97f4a7c15ull) * 0xff51afd7ed558ccdull;
+        sig.push_back({h, u});
+      }
+      std::sort(sig.begin(), sig.end());
+      for (size_t a2 = 0; a2 < sig.size(); ++a2) {
+        int64_t u1 = sig[a2].second;
+        if (dead[u1]) continue;
+        for (size_t b2 = a2 + 1;
+             b2 < sig.size() && sig[b2].first == sig[a2].first; ++b2) {
+          int64_t u2 = sig[b2].second;
+          if (dead[u2]) continue;
+          // exact test: adj sets equal modulo {u1,u2}, element sets equal
+          ++stamp;
+          int64_t c1 = 0;
+          for (int64_t w2 : adj[u1])
+            if (!dead[w2] && w2 != u2) { mark[w2] = stamp; ++c1; }
+          bool same = true;
+          int64_t c2 = 0;
+          for (int64_t w2 : adj[u2]) {
+            if (dead[w2] || w2 == u1) continue;
+            ++c2;
+            if (mark[w2] != stamp) { same = false; break; }
+          }
+          if (!same || c1 != c2) continue;
+          std::vector<int64_t> e1 = els[u1], e2 = els[u2];
+          std::sort(e1.begin(), e1.end());
+          std::sort(e2.begin(), e2.end());
+          if (e1 != e2) continue;
+          nv[u1] += nv[u2];
+          dead[u2] = 1;
+          members[u1].insert(members[u1].end(), members[u2].begin(),
+                             members[u2].end());
+          members[u2].clear();
+          adj[u2].clear();
+          els[u2].clear();
+        }
+      }
+    }
+
+    // refresh degrees of the element's surviving members
+    for (int64_t u : pivot_nbrs) {
+      if (dead[u]) continue;
+      degree[u] = exact_degree(u);
+      heap.push({degree[u], u});
+    }
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------- mc64
+// Maximum-product-of-diagonal bipartite matching (MC64 job=5) by
+// shortest augmenting paths with dual potentials (the Duff–Koster
+// algorithm; also the sparse Jonker–Volgenant assignment).  Input is
+// CSC of the n×n pattern with |a_ij| values (zeros allowed — skipped).
+// Edge weight w(i,j) = log(cmax_j / |a_ij|) ≥ 0; a minimum-weight
+// perfect matching maximizes the product of matched magnitudes.
+//
+// Outputs: rowperm[i] = matched column of row i (row i moves to
+// position rowperm[i]); duals u (rows), v (cols) satisfying
+// w(i,j) − u_i − v_j ≥ 0 with equality on matched edges, from which
+// the MC64 job=5 scalings are R_i = exp(u_i), C_j = exp(v_j)/cmax_j.
+// Returns 0 on success, -1 if structurally singular.
+int64_t slu_mc64(int64_t n, const int64_t* colptr, const int64_t* rowind,
+                 const double* absval, int64_t* rowperm, double* u,
+                 double* v) {
+  const double INF = std::numeric_limits<double>::infinity();
+  std::vector<double> w(colptr[n]);
+  std::vector<double> cmax(n, 0.0);
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
+      if (absval[p] > cmax[j]) cmax[j] = absval[p];
+  for (int64_t j = 0; j < n; ++j) {
+    if (cmax[j] <= 0.0) return -1;  // structurally empty column
+    double lc = std::log(cmax[j]);
+    for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
+      w[p] = (absval[p] > 0.0) ? lc - std::log(absval[p]) : INF;
+  }
+
+  std::vector<int64_t> match_row(n, -1);  // row -> col
+  std::vector<int64_t> match_col(n, -1);  // col -> row
+  for (int64_t i = 0; i < n; ++i) u[i] = INF;
+  for (int64_t j = 0; j < n; ++j) v[j] = 0.0;
+  // feasible start: u_i = cheapest incident edge (then w − u − 0 ≥ 0)
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
+      if (w[p] < u[rowind[p]]) u[rowind[p]] = w[p];
+  for (int64_t i = 0; i < n; ++i)
+    if (u[i] == INF) return -1;  // structurally empty row
+
+  // cheap assignment pass on tight edges
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p) {
+      int64_t i = rowind[p];
+      if (match_row[i] == -1 && w[p] - u[i] <= 0.0) {
+        match_row[i] = j;
+        match_col[j] = i;
+        break;
+      }
+    }
+
+  std::vector<double> dist(n);
+  std::vector<int64_t> prev_col(n);  // row -> column it was reached from
+  std::vector<char> done(n);
+  std::vector<int64_t> done_rows;
+  using QI = std::pair<double, int64_t>;  // (dist, row)
+  for (int64_t j0 = 0; j0 < n; ++j0) {
+    if (match_col[j0] != -1) continue;
+    std::fill(dist.begin(), dist.end(), INF);
+    std::fill(done.begin(), done.end(), 0);
+    done_rows.clear();
+    std::priority_queue<QI, std::vector<QI>, std::greater<QI>> pq;
+    for (int64_t p = colptr[j0]; p < colptr[j0 + 1]; ++p) {
+      int64_t i = rowind[p];
+      double d = w[p] - v[j0] - u[i];
+      if (d < dist[i]) {
+        dist[i] = d;
+        prev_col[i] = j0;
+        pq.push({d, i});
+      }
+    }
+    double lsp = INF;
+    int64_t isp = -1;
+    while (!pq.empty()) {
+      auto [d, i] = pq.top();
+      pq.pop();
+      if (done[i] || d > dist[i]) continue;
+      done[i] = 1;
+      done_rows.push_back(i);
+      int64_t jm = match_row[i];
+      if (jm == -1) { lsp = d; isp = i; break; }
+      for (int64_t p = colptr[jm]; p < colptr[jm + 1]; ++p) {
+        int64_t i2 = rowind[p];
+        if (done[i2] || w[p] == INF) continue;
+        double d2 = d + (w[p] - v[jm] - u[i2]);
+        if (d2 < dist[i2]) {
+          dist[i2] = d2;
+          prev_col[i2] = jm;
+          pq.push({d2, i2});
+        }
+      }
+    }
+    if (isp == -1) return -1;  // no augmenting path: singular
+
+    // dual update on finalized rows keeps feasibility (d ≤ lsp there)
+    for (int64_t i : done_rows) u[i] += dist[i] - lsp;
+    // augment along the prev_col chain
+    int64_t i = isp;
+    while (true) {
+      int64_t j = prev_col[i];
+      int64_t iold = match_col[j];
+      match_col[j] = i;
+      match_row[i] = j;
+      if (j == j0) break;
+      i = iold;
+    }
+    // retighten matched edges of rows whose dual moved
+    for (int64_t i2 : done_rows) {
+      int64_t j = match_row[i2];
+      if (j == -1) continue;
+      for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
+        if (rowind[p] == i2) { v[j] = w[p] - u[i2]; break; }
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) rowperm[i] = match_row[i];
+  return 0;
+}
+
+// ------------------------------------------------------------- symbfact
+// Supernodal symbolic factorization: per-supernode union pass over the
+// postordered supernodal etree (the reference's symbfact computes the
+// same structures column-by-column, SRC/symbfact.c:81; supernode
+// granularity here matches superlu_dist_tpu/plan/symbolic.py).
+// Handle-based: create → query sizes → copy out → free.
+struct SymbHandle {
+  std::vector<std::vector<int64_t>> structs;
+  int64_t total = 0;
+};
+
+void* slu_symbfact_create(int64_t n, const int64_t* b_indptr,
+                          const int64_t* b_indices, int64_t nsuper,
+                          const int64_t* xsup, const int64_t* sparent) {
+  auto* h = new SymbHandle();
+  h->structs.resize(nsuper);
+  std::vector<std::vector<int64_t>> children(nsuper);
+  for (int64_t s = 0; s < nsuper; ++s)
+    if (sparent[s] != -1) children[sparent[s]].push_back(s);
+  std::vector<int64_t> mark(n, -1);
+  std::vector<int64_t> rows;
+  for (int64_t s = 0; s < nsuper; ++s) {
+    int64_t last = xsup[s + 1] - 1;
+    rows.clear();
+    for (int64_t j = xsup[s]; j <= last; ++j)
+      for (int64_t p = b_indptr[j]; p < b_indptr[j + 1]; ++p) {
+        int64_t i = b_indices[p];
+        if (i > last && mark[i] != s) { mark[i] = s; rows.push_back(i); }
+      }
+    for (int64_t c : children[s])
+      for (int64_t i : h->structs[c])
+        if (i > last && mark[i] != s) { mark[i] = s; rows.push_back(i); }
+    std::sort(rows.begin(), rows.end());
+    h->structs[s] = rows;
+    h->total += (int64_t)rows.size();
+  }
+  return h;
+}
+
+int64_t slu_symbfact_total(void* handle) {
+  return static_cast<SymbHandle*>(handle)->total;
+}
+
+void slu_symbfact_sizes(void* handle, int64_t* sizes) {
+  auto* h = static_cast<SymbHandle*>(handle);
+  for (size_t s = 0; s < h->structs.size(); ++s)
+    sizes[s] = (int64_t)h->structs[s].size();
+}
+
+void slu_symbfact_fill(void* handle, int64_t* flat) {
+  auto* h = static_cast<SymbHandle*>(handle);
+  int64_t off = 0;
+  for (auto& vec : h->structs) {
+    std::memcpy(flat + off, vec.data(), vec.size() * sizeof(int64_t));
+    off += (int64_t)vec.size();
+  }
+}
+
+void slu_symbfact_free(void* handle) {
+  delete static_cast<SymbHandle*>(handle);
+}
+
+int64_t slu_version() { return 1; }
+
+}  // extern "C"

@@ -31,25 +31,35 @@ def _refine_dtype(opts, a_dtype):
     return base
 
 
-def _operands(lu):
+def _operands(lu, sys_dtype):
     """A and |A| in refine precision, cached on the factorization
     handle (the FACTORED rung exists for repeated solves; rebuilding
     these per solve would be an O(nnz) tax on every call)."""
-    rdt = _refine_dtype(lu.effective_options, lu.a.dtype)
+    rdt = _refine_dtype(lu.effective_options, sys_dtype)
+    # store A in the real precision of rdt when A itself is real:
+    # numpy promotion in `b - A @ x` gives the identical complex
+    # residual without doubling the cached matrix or the SpMV cost
+    adt = rdt
+    if (not np.issubdtype(lu.a.dtype, np.complexfloating)
+            and np.issubdtype(rdt, np.complexfloating)):
+        adt = np.dtype(np.dtype(rdt).char.lower())  # c->f of same width
     cache = lu.refine_cache
-    if cache is None or cache.get("dtype") != rdt:
-        asp = lu.a.to_scipy().astype(rdt)
+    if cache is None or cache.get("dtype") != adt:
+        asp = lu.a.to_scipy().astype(adt)
         lu.refine_cache = cache = {
-            "dtype": rdt, "asp": asp, "abs_a": abs(asp)}
+            "dtype": adt, "asp": asp, "abs_a": abs(asp)}
     return cache["asp"], cache["abs_a"]
 
 
 def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
                      from_factor_sol):
     opts = lu.effective_options
-    rdt = _refine_dtype(opts, lu.a.dtype)
+    # the system's realness is set by matrix AND rhs: a real matrix
+    # with a complex b still needs a complex accumulator
+    sys_dtype = np.promote_types(lu.a.dtype, b.dtype)
+    rdt = _refine_dtype(opts, sys_dtype)
     eps = np.finfo(rdt).eps
-    asp, abs_a = _operands(lu)
+    asp, abs_a = _operands(lu, sys_dtype)
     xk = x.astype(rdt)
     bk = b.astype(rdt)
 

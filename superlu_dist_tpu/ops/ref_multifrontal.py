@@ -107,7 +107,10 @@ def solve_host(lu: HostLU, b: np.ndarray) -> np.ndarray:
     part = fp.sym.part
     xsup = part.xsup
     ns = fp.nsuper
-    x = b.copy()
+    # promote rather than copy: a real rhs against a complex factor
+    # must become complex (mirrors the device backend's promote_types)
+    xdt = np.promote_types(lu.L[0].dtype if ns else b.dtype, b.dtype)
+    x = b.astype(xdt)
     squeeze = x.ndim == 1
     if squeeze:
         x = x[:, None]

@@ -22,9 +22,23 @@ from __future__ import annotations
 import numpy as np
 
 
+def _native():
+    """The C++ host library (csrc/slu_host.cpp) or None."""
+    from ..utils.native import native_or_none
+    return native_or_none()
+
+
 def etree_symmetric(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
     """Elimination tree of a symmetric-pattern matrix (Liu's algorithm
     with path compression).  Returns parent[j] (or -1 for roots)."""
+    nat = _native()
+    if nat is not None:
+        return nat.etree(indptr, indices, n)
+    return etree_symmetric_py(indptr, indices, n)
+
+
+def etree_symmetric_py(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
+    """Pure-Python fallback / test oracle for etree_symmetric."""
     parent = np.full(n, -1, dtype=np.int64)
     ancestor = np.full(n, -1, dtype=np.int64)
     for j in range(n):
@@ -50,6 +64,14 @@ def etree_symmetric(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarr
 def postorder(parent: np.ndarray) -> np.ndarray:
     """Postorder of the forest.  Returns post[k] = k-th column in
     postorder (iterative DFS, children in ascending order)."""
+    nat = _native()
+    if nat is not None:
+        return nat.postorder(np.ascontiguousarray(parent, dtype=np.int64))
+    return postorder_py(parent)
+
+
+def postorder_py(parent: np.ndarray) -> np.ndarray:
+    """Pure-Python fallback / test oracle for postorder."""
     n = len(parent)
     # build child lists as head/next arrays (reverse iteration gives
     # ascending-order children when consuming the linked list)
@@ -96,6 +118,17 @@ def relabel_tree(parent: np.ndarray, post: np.ndarray) -> np.ndarray:
 
 def col_counts_postordered(indptr: np.ndarray, indices: np.ndarray,
                            parent: np.ndarray) -> np.ndarray:
+    """Column counts |L(:,j)| of the postordered Cholesky factor;
+    dispatches to the native library, Python fallback below."""
+    nat = _native()
+    if nat is not None:
+        return nat.col_counts(indptr, indices,
+                              np.ascontiguousarray(parent, dtype=np.int64))
+    return col_counts_postordered_py(indptr, indices, parent)
+
+
+def col_counts_postordered_py(indptr: np.ndarray, indices: np.ndarray,
+                              parent: np.ndarray) -> np.ndarray:
     """Column counts |L(:,j)| (including the diagonal) of the Cholesky
     factor of a symmetric-pattern matrix whose columns are already in
     postorder (parent[j] > j for all non-roots).

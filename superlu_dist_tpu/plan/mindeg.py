@@ -80,12 +80,10 @@ def md_order(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
 def amd_order(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
     """Dispatch: native C++ AMD when available, else Python MD for
     small n, else nested dissection."""
-    try:
-        from ..utils import native
-        if native.available():
-            return native.amd_order(indptr, indices, n)
-    except ImportError:
-        pass
+    from ..utils.native import native_or_none
+    native = native_or_none()
+    if native is not None:
+        return native.amd_order(indptr, indices, n)
     if n <= 4000:
         return md_order(indptr, indices, n)
     from .nested import nd_order

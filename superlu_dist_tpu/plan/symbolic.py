@@ -52,7 +52,34 @@ class SymbolicFactorization:
 def symbolic_factorize(b_indptr: np.ndarray, b_indices: np.ndarray,
                        part: SupernodePartition) -> SymbolicFactorization:
     """B is the symmetrized pattern CSR in the final (postordered)
-    column order."""
+    column order.  Dispatches to the native union pass
+    (csrc/slu_host.cpp slu_symbfact_*); Python fallback below."""
+    from ..utils.native import native_or_none
+    native = native_or_none()
+    if native is not None:
+        n = len(b_indptr) - 1
+        struct = native.symbfact(
+            n, b_indptr, b_indices, part.nsuper,
+            np.ascontiguousarray(part.xsup, dtype=np.int64),
+            np.ascontiguousarray(part.sparent, dtype=np.int64))
+        return SymbolicFactorization(
+            part=part, struct=struct,
+            children=_child_lists(part))
+    return symbolic_factorize_py(b_indptr, b_indices, part)
+
+
+def _child_lists(part: SupernodePartition) -> List[np.ndarray]:
+    children: List[list] = [[] for _ in range(part.nsuper)]
+    for s in range(part.nsuper):
+        p = part.sparent[s]
+        if p != -1:
+            children[p].append(s)
+    return [np.asarray(c, dtype=np.int64) for c in children]
+
+
+def symbolic_factorize_py(b_indptr: np.ndarray, b_indices: np.ndarray,
+                          part: SupernodePartition) -> SymbolicFactorization:
+    """Pure-Python fallback / test oracle for symbolic_factorize."""
     ns = part.nsuper
     xsup = part.xsup
     children: List[list] = [[] for _ in range(ns)]

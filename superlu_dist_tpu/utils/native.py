@@ -1,0 +1,211 @@
+"""ctypes bindings for the native host library (csrc/slu_host.cpp).
+
+The reference implements its sequential preprocessing passes in C
+(SRC/etree.c, SRC/mmd.c, SRC/mc64ad_dist.c, SRC/symbfact.c); this build
+keeps them native too, compiled once into `_slu_host.so` and loaded via
+ctypes.  Every entry point has a pure-Python twin in
+superlu_dist_tpu/plan/ that serves as fallback and test oracle, so the
+library is an accelerator, never a requirement.
+
+The shared object is built lazily on first use (g++ -O3 -shared); a
+build failure is remembered and everything silently falls back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_I64 = ctypes.POINTER(ctypes.c_int64)
+_F64 = ctypes.POINTER(ctypes.c_double)
+
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_slu_host.so")
+
+
+def _build() -> str | None:
+    src = os.path.join(_repo_root(), "csrc", "slu_host.cpp")
+    out = _so_path()
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = f"{out}.{os.getpid()}.tmp"  # unique: concurrent builds race
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", src,
+             "-o", tmp],
+            check=True, capture_output=True, timeout=300)
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load():
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        if os.environ.get("SLU_TPU_NO_NATIVE"):
+            _failed = True
+            return None
+        path = _build()
+        if path is None:
+            _failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.slu_etree.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
+            lib.slu_postorder.argtypes = [ctypes.c_int64, _I64, _I64]
+            lib.slu_colcounts.argtypes = [ctypes.c_int64, _I64, _I64,
+                                          _I64, _I64]
+            lib.slu_mdorder.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
+            lib.slu_mdorder.restype = ctypes.c_int64
+            lib.slu_mc64.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
+                                     _I64, _F64, _F64]
+            lib.slu_mc64.restype = ctypes.c_int64
+            lib.slu_symbfact_create.argtypes = [
+                ctypes.c_int64, _I64, _I64, ctypes.c_int64, _I64, _I64]
+            lib.slu_symbfact_create.restype = ctypes.c_void_p
+            lib.slu_symbfact_total.argtypes = [ctypes.c_void_p]
+            lib.slu_symbfact_total.restype = ctypes.c_int64
+            lib.slu_symbfact_sizes.argtypes = [ctypes.c_void_p, _I64]
+            lib.slu_symbfact_fill.argtypes = [ctypes.c_void_p, _I64]
+            lib.slu_symbfact_free.argtypes = [ctypes.c_void_p]
+            lib.slu_version.restype = ctypes.c_int64
+            assert lib.slu_version() == 1
+            _lib = lib
+        except (OSError, AssertionError, AttributeError):
+            _failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def native_or_none():
+    """Shared dispatch probe: this module when the library loads, else
+    None.  Plan-layer call sites use this instead of re-rolling the
+    try-import/availability boilerplate."""
+    import sys
+    mod = sys.modules[__name__]
+    return mod if available() else None
+
+
+def _c64(a: np.ndarray):
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    return a, a.ctypes.data_as(_I64)
+
+
+def _cf64(a: np.ndarray):
+    a = np.ascontiguousarray(a, dtype=np.float64)
+    return a, a.ctypes.data_as(_F64)
+
+
+def etree(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
+    lib = _load()
+    _, pp = _c64(indptr)
+    _, pi = _c64(indices)
+    parent = np.empty(n, dtype=np.int64)
+    lib.slu_etree(n, pp, pi, parent.ctypes.data_as(_I64))
+    return parent
+
+
+def postorder(parent: np.ndarray) -> np.ndarray:
+    lib = _load()
+    n = len(parent)
+    _, pp = _c64(parent)
+    post = np.empty(n, dtype=np.int64)
+    lib.slu_postorder(n, pp, post.ctypes.data_as(_I64))
+    return post
+
+
+def col_counts(indptr: np.ndarray, indices: np.ndarray,
+               parent: np.ndarray) -> np.ndarray:
+    lib = _load()
+    n = len(parent)
+    _, pp = _c64(indptr)
+    _, pi = _c64(indices)
+    _, pa = _c64(parent)
+    cc = np.empty(n, dtype=np.int64)
+    lib.slu_colcounts(n, pp, pi, pa, cc.ctypes.data_as(_I64))
+    return cc
+
+
+def amd_order(indptr: np.ndarray, indices: np.ndarray,
+              n: int) -> np.ndarray:
+    """Minimum-degree ordering; returns order[k] = k-th pivot."""
+    lib = _load()
+    _, pp = _c64(indptr)
+    _, pi = _c64(indices)
+    order = np.empty(n, dtype=np.int64)
+    got = lib.slu_mdorder(n, pp, pi, order.ctypes.data_as(_I64))
+    if got != n:
+        raise RuntimeError(f"native mdorder returned {got} of {n} pivots")
+    return order
+
+
+def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
+         absval: np.ndarray):
+    """MC64 job=5 on CSC input.  Returns (rowperm, u, v) where
+    rowperm[i] = destination position of row i and (u, v) are the dual
+    potentials (R_i = exp(u_i), C_j = exp(v_j)/cmax_j scalings)."""
+    lib = _load()
+    _, pc = _c64(colptr)
+    _, pr = _c64(rowind)
+    _, pv = _cf64(absval)
+    perm = np.empty(n, dtype=np.int64)
+    u = np.empty(n, dtype=np.float64)
+    v = np.empty(n, dtype=np.float64)
+    rc = lib.slu_mc64(n, pc, pr, pv, perm.ctypes.data_as(_I64),
+                      u.ctypes.data_as(_F64), v.ctypes.data_as(_F64))
+    if rc != 0:
+        raise ValueError("structurally singular matrix (native mc64)")
+    return perm, u, v
+
+
+def symbfact(n: int, b_indptr: np.ndarray, b_indices: np.ndarray,
+             nsuper: int, xsup: np.ndarray, sparent: np.ndarray):
+    """Supernodal symbolic factorization.  Returns a list of
+    per-supernode sorted off-block row index arrays."""
+    lib = _load()
+    _, pp = _c64(b_indptr)
+    _, pi = _c64(b_indices)
+    _, px = _c64(xsup)
+    _, ps = _c64(sparent)
+    h = lib.slu_symbfact_create(n, pp, pi, nsuper, px, ps)
+    if not h:
+        raise MemoryError("slu_symbfact_create failed")
+    try:
+        sizes = np.empty(nsuper, dtype=np.int64)
+        lib.slu_symbfact_sizes(h, sizes.ctypes.data_as(_I64))
+        flat = np.empty(int(lib.slu_symbfact_total(h)), dtype=np.int64)
+        lib.slu_symbfact_fill(h, flat.ctypes.data_as(_I64))
+    finally:
+        lib.slu_symbfact_free(h)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    return [flat[offs[s]:offs[s + 1]] for s in range(nsuper)]

@@ -72,3 +72,39 @@ def test_complex_factored_reuse(problem):
         xtrue, b = manufactured_rhs(a, seed=seed)
         x = solve(lu, b)
         np.testing.assert_allclose(np.asarray(x), xtrue, rtol=1e-8)
+
+
+def test_complex_rhs_real_matrix_refinement():
+    """A real matrix with a complex RHS must keep a complex refinement
+    accumulator (regression: refine cast x/b to float and discarded the
+    imaginary part)."""
+    import numpy as np
+    from superlu_dist_tpu import Options, gssvx
+    from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+    a = laplacian_2d(8)
+    asp = a.to_scipy()
+    rng = np.random.default_rng(3)
+    xtrue = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
+    b = asp @ xtrue
+    for opts in (Options(), Options(factor_dtype="complex128"),
+                 Options(factor_dtype="float32")):
+        x, _, stats = gssvx(opts, a, b, backend="host")
+        relres = np.linalg.norm(asp @ x - b) / np.linalg.norm(b)
+        assert relres < 1e-10, (opts.factor_dtype, relres)
+
+
+def test_complex_matrix_real_rhs():
+    """Complex factor with a real RHS must promote, both backends."""
+    import numpy as np
+    from superlu_dist_tpu import Options, gssvx
+    from superlu_dist_tpu.utils.testmat import helmholtz_2d
+
+    a = helmholtz_2d(6)
+    asp = a.to_scipy()
+    b = np.ones(a.n)
+    for be in ("host", "jax"):
+        x, _, _ = gssvx(Options(factor_dtype="complex128"), a, b,
+                        backend=be)
+        relres = np.linalg.norm(asp @ x - b) / np.linalg.norm(b)
+        assert relres < 1e-10, (be, relres)

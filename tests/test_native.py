@@ -1,0 +1,157 @@
+"""Native C++ host library (csrc/slu_host.cpp) vs Python oracles.
+
+Mirrors the reference's stance that preprocessing passes are native
+(SRC/etree.c, SRC/mmd.c, SRC/mc64ad_dist.c, SRC/symbfact.c) while
+keeping the Python implementations as the comparison oracle.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu.plan.etree import (col_counts_postordered_py,
+                                         etree_symmetric_py, postorder_py,
+                                         relabel_tree)
+from superlu_dist_tpu.plan.rowperm import large_diag_perm_py
+from superlu_dist_tpu.plan.supernodes import find_supernodes
+from superlu_dist_tpu.plan.symbolic import symbolic_factorize_py
+from superlu_dist_tpu.sparse import CSRMatrix
+from superlu_dist_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _random_pattern(rng, n):
+    d = rng.uniform(0.03, 0.25)
+    a = sp.random(n, n, density=d, random_state=rng) + sp.eye(n)
+    b = ((a + a.T) != 0).tocsr()
+    b.sort_indices()
+    return a.tocsr(), b
+
+
+def _sym_cases():
+    rng = np.random.default_rng(7)
+    return [(_random_pattern(rng, n)) for n in (5, 23, 60, 150)]
+
+
+def test_etree_postorder_colcounts_match_python():
+    for _, b in _sym_cases():
+        n = b.shape[0]
+        ip = b.indptr.astype(np.int64)
+        ix = b.indices.astype(np.int64)
+        parent_py = etree_symmetric_py(ip, ix, n)
+        parent_c = native.etree(ip, ix, n)
+        np.testing.assert_array_equal(parent_py, parent_c)
+        post_py = postorder_py(parent_py)
+        post_c = native.postorder(parent_c)
+        np.testing.assert_array_equal(post_py, post_c)
+        bp = b[post_py][:, post_py].tocsr()
+        bp.sort_indices()
+        par2 = relabel_tree(parent_py, post_py)
+        bpp = bp.indptr.astype(np.int64)
+        bpi = bp.indices.astype(np.int64)
+        np.testing.assert_array_equal(
+            col_counts_postordered_py(bpp, bpi, par2),
+            native.col_counts(bpp, bpi, par2))
+
+
+def test_mdorder_is_perm_and_fill_competitive():
+    """Native MD must produce a valid permutation with fill within 1.3×
+    of the (exact, slow) Python minimum degree."""
+    rng = np.random.default_rng(3)
+    for n in (30, 80, 160):
+        _, b = _random_pattern(rng, n)
+        ip = b.indptr.astype(np.int64)
+        ix = b.indices.astype(np.int64)
+        order_c = native.amd_order(ip, ix, n)
+        assert sorted(order_c) == list(range(n))
+
+        def fill(order):
+            perm = np.empty(n, dtype=np.int64)
+            perm[order] = np.arange(n)
+            bp = b[order][:, order].tocsr()
+            bp.sort_indices()
+            parent = etree_symmetric_py(bp.indptr.astype(np.int64),
+                                        bp.indices.astype(np.int64), n)
+            post = postorder_py(parent)
+            bpp = bp[post][:, post].tocsr()
+            bpp.sort_indices()
+            par2 = relabel_tree(parent, post)
+            return int(col_counts_postordered_py(
+                bpp.indptr.astype(np.int64),
+                bpp.indices.astype(np.int64), par2).sum())
+
+        from superlu_dist_tpu.plan.mindeg import md_order
+        fill_c = fill(order_c)
+        fill_py = fill(md_order(ip, ix, n))
+        assert fill_c <= 1.3 * fill_py + 10, (fill_c, fill_py)
+
+
+def test_mc64_optimal_and_feasible():
+    rng = np.random.default_rng(11)
+    for n in (10, 40, 120):
+        a, _ = _random_pattern(rng, n)
+        acsc = a.tocsc()
+        acsc.sort_indices()
+        perm, u, v = native.mc64(n, acsc.indptr.astype(np.int64),
+                                 acsc.indices.astype(np.int64),
+                                 np.abs(acsc.data))
+        assert sorted(perm) == list(range(n))
+        ad = np.abs(a.toarray())
+        diag = np.array([ad[i, perm[i]] for i in range(n)])
+        assert (diag > 0).all()
+        # optimality: log-product equals the scipy-matching oracle's
+        A = CSRMatrix(n, n, a.indptr.astype(np.int64),
+                      a.indices.astype(np.int64), a.data)
+        perm_py = large_diag_perm_py(A)
+        lp_py = np.log([ad[i, perm_py[i]] for i in range(n)]).sum()
+        lp_c = np.log(diag).sum()
+        assert abs(lp_py - lp_c) <= 1e-8 * max(1.0, abs(lp_py))
+        # dual feasibility + complementary slackness on matched edges
+        for j in range(n):
+            rows = acsc.indices[acsc.indptr[j]:acsc.indptr[j + 1]]
+            av = np.abs(acsc.data[acsc.indptr[j]:acsc.indptr[j + 1]])
+            w = np.log(av.max()) - np.log(av)
+            assert (w - u[rows] - v[j]).min() > -1e-9
+        inv = np.empty(n, dtype=np.int64)
+        inv[perm] = np.arange(n)
+        for j in range(n):
+            i = inv[j]
+            w_ij = np.log(ad[:, j].max()) - np.log(ad[i, j])
+            assert abs(w_ij - u[i] - v[j]) < 1e-8
+
+
+def test_symbfact_matches_python():
+    rng = np.random.default_rng(5)
+    for n in (20, 70, 140):
+        _, b = _random_pattern(rng, n)
+        ip = b.indptr.astype(np.int64)
+        ix = b.indices.astype(np.int64)
+        parent = etree_symmetric_py(ip, ix, n)
+        post = postorder_py(parent)
+        bp = b[post][:, post].tocsr()
+        bp.sort_indices()
+        par2 = relabel_tree(parent, post)
+        bpp = bp.indptr.astype(np.int64)
+        bpi = bp.indices.astype(np.int64)
+        cc = col_counts_postordered_py(bpp, bpi, par2)
+        part = find_supernodes(par2, cc, relax=4, max_super=16)
+        sym_py = symbolic_factorize_py(bpp, bpi, part)
+        struct_c = native.symbfact(n, bpp, bpi, part.nsuper,
+                                   part.xsup, part.sparent)
+        assert len(struct_c) == part.nsuper
+        for s in range(part.nsuper):
+            np.testing.assert_array_equal(sym_py.struct[s], struct_c[s])
+
+
+def test_end_to_end_solve_with_native(laplacian_solver_check=None):
+    """Full pipeline with native preprocessing must solve correctly."""
+    from superlu_dist_tpu import Options, gssvx
+    from superlu_dist_tpu.utils.testmat import (laplacian_2d,
+                                                manufactured_rhs)
+    a = laplacian_2d(14)
+    xtrue, b = manufactured_rhs(a)
+    x, lu, stats = gssvx(Options(), a, b, backend="host")
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-10
