@@ -105,6 +105,15 @@ def _solve_factored(lu: LUFactorization, b_factor_order: np.ndarray):
     return batched.solve_device(lu.device_lu, b_factor_order)
 
 
+def _solve_factored_trans(lu: LUFactorization, b_factor_order: np.ndarray):
+    """Mᵀ·y = b in factor ordering (forward Uᵀ, backward Lᵀ)."""
+    if lu.backend == "host":
+        return ref_multifrontal.solve_host_trans(lu.host_lu,
+                                                 b_factor_order)
+    from ..ops import batched
+    return batched.solve_device_trans(lu.device_lu, b_factor_order)
+
+
 def solve(lu: LUFactorization, b: np.ndarray,
           stats: Stats | None = None) -> np.ndarray:
     """Solve A·x = b for one or many right-hand sides (b: (n,) or
@@ -114,12 +123,6 @@ def solve(lu: LUFactorization, b: np.ndarray,
     plan = lu.plan
     stats = stats or lu.stats or Stats()
     options = lu.effective_options
-    if options.trans != Trans.NOTRANS:
-        # transpose solve (pdgssvx trans contract) lands with the
-        # dedicated Aᵀ sweep; fail loudly instead of silently solving
-        # the NOTRANS system.
-        raise NotImplementedError(
-            "Trans.TRANS/CONJ solves are not implemented yet")
     b = np.asarray(b)
     if b.shape[0] != plan.n:
         raise ValueError(
@@ -127,25 +130,56 @@ def solve(lu: LUFactorization, b: np.ndarray,
     squeeze = b.ndim == 1
     bb = b[:, None] if squeeze else b
 
-    # b' = Pfinal · Dr · b ; x = Dc · Pfinalᵀ · y
-    def to_factor_rhs(v):
-        scaled = v * plan.row_scale[:, None]
-        out = np.empty_like(scaled)
-        out[plan.final_row] = scaled
-        return out
+    if options.trans == Trans.CONJ:
+        # (Aᴴ)⁻¹·b = conj((Aᵀ)⁻¹·conj(b)) — run the TRANS pipeline
+        # (refinement included) on the conjugated system
+        merged = options.replace(trans=Trans.TRANS)
+        lu_t = dataclasses.replace(lu, options=merged)
+        x = solve(lu_t, np.conj(bb), stats=stats)
+        # keep the refinement operand cache the inner solve built (the
+        # handle copy is throwaway; the cache is what FACTORED reuses)
+        lu.refine_cache = lu_t.refine_cache
+        x = np.conj(x)
+        return x[:, 0] if squeeze else x
 
-    def from_factor_sol(y):
-        out = y[plan.final_col]
-        return out * plan.col_scale[:, None]
+    if options.trans == Trans.NOTRANS:
+        # M = Pf_r·Dr·A·Dc·Pf_cᵀ:  b' = Pf_r·Dr·b ; x = Dc·Pf_cᵀ·y
+        def to_factor_rhs(v):
+            scaled = v * plan.row_scale[:, None]
+            out = np.empty_like(scaled)
+            out[plan.final_row] = scaled
+            return out
+
+        def from_factor_sol(y):
+            out = y[plan.final_col]
+            return out * plan.col_scale[:, None]
+
+        solver = _solve_factored
+    else:
+        # Aᵀ = Dr⁻¹... algebra: (Aᵀ)⁻¹ = Dr·Pf_rᵀ·M⁻ᵀ·Pf_c·Dc, so the
+        # roles of (row perm, row scale) and (col perm, col scale) swap
+        # around the Mᵀ solve (the pdgssvx TRANS contract)
+        def to_factor_rhs(v):
+            scaled = v * plan.col_scale[:, None]
+            out = np.empty_like(scaled)
+            out[plan.final_col] = scaled
+            return out
+
+        def from_factor_sol(y):
+            out = y[plan.final_row]
+            return out * plan.row_scale[:, None]
+
+        solver = _solve_factored_trans
 
     with stats.timer("SOLVE"):
-        x = from_factor_sol(_solve_factored(lu, to_factor_rhs(bb)))
+        x = from_factor_sol(solver(lu, to_factor_rhs(bb)))
 
     if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
         from .refine import iterative_refine
         with stats.timer("REFINE"):
             x, berr, steps = iterative_refine(
-                lu, bb, x, _solve_factored, to_factor_rhs, from_factor_sol)
+                lu, bb, x, solver, to_factor_rhs, from_factor_sol,
+                trans=(options.trans == Trans.TRANS))
         stats.berr = berr
         stats.refine_steps += steps
 

@@ -52,7 +52,7 @@ def _operands(lu, sys_dtype):
 
 
 def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
-                     from_factor_sol):
+                     from_factor_sol, trans: bool = False):
     opts = lu.effective_options
     # the system's realness is set by matrix AND rhs: a real matrix
     # with a complex b still needs a complex accumulator
@@ -60,6 +60,9 @@ def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
     rdt = _refine_dtype(opts, sys_dtype)
     eps = np.finfo(rdt).eps
     asp, abs_a = _operands(lu, sys_dtype)
+    if trans:
+        asp = asp.T
+        abs_a = abs_a.T
     xk = x.astype(rdt)
     bk = b.astype(rdt)
 

@@ -376,6 +376,63 @@ _bwd_group = functools.partial(
     donate_argnames=("X",))(_bwd_group_impl)
 
 
+# transpose sweeps: Mᵀ = Uᵀ·Lᵀ — forward on lower-triangular Uᵀ,
+# backward on unit-upper Lᵀ, same schedule/groups, panels transposed
+# on the fly (einsum-transpose is free on the MXU)
+
+def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
+                      Ui_off, *, mb: int, wb: int, n_pad: int,
+                      axis: Optional[str] = None):
+    xb = X[col_idx]
+    Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
+                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    y = jnp.einsum("nwv,nwr->nvr", Ui, xb)          # Uiᵀ @ xb
+    if mb > wb:
+        Up = jax.lax.dynamic_slice(
+            U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
+    if axis is None:
+        X = X.at[col_idx].set(y)
+        if mb > wb:
+            X = X.at[struct_idx].add(
+                -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y))
+        return X
+    delta = jnp.zeros_like(X).at[col_idx].add(y - xb)
+    if mb > wb:
+        delta = delta.at[struct_idx].add(
+            -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y))
+    return X + jax.lax.psum(delta, axis)
+
+
+_fwd_group_T = functools.partial(
+    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
+    donate_argnames=("X",))(_fwd_group_T_impl)
+
+
+def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
+                      Li_off, *, mb: int, wb: int, n_pad: int,
+                      axis: Optional[str] = None):
+    xb = X[col_idx]
+    if mb > wb:
+        Lp = jax.lax.dynamic_slice(
+            L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
+        xs = X[struct_idx]
+        rhs = xb - jnp.einsum("nsw,nsr->nwr", Lp[:, wb:, :], xs)
+    else:
+        rhs = xb
+    Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
+                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    x1 = jnp.einsum("nwv,nwr->nvr", Li, rhs)        # Liᵀ @ rhs
+    if axis is None:
+        return X.at[col_idx].set(x1)
+    delta = jnp.zeros_like(X).at[col_idx].add(x1 - xb)
+    return X + jax.lax.psum(delta, axis)
+
+
+_bwd_group_T = functools.partial(
+    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
+    donate_argnames=("X",))(_bwd_group_T_impl)
+
+
 # --------------------------------------------------------------------
 # single-device driver API
 # --------------------------------------------------------------------
@@ -461,6 +518,31 @@ def solve_device(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
     return out[:, 0] if squeeze else out
 
 
+def solve_device_trans(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
+    """Solve Mᵀ·x = b (factor ordering): forward with Uᵀ, backward
+    with Lᵀ over the same group schedule."""
+    sched = lu.schedule
+    squeeze = b.ndim == 1
+    bb = b[:, None] if squeeze else b
+    xdt = np.promote_types(lu.dtype, bb.dtype)
+    X = jnp.zeros((sched.n + 1, bb.shape[1]), xdt)
+    X = X.at[:sched.n, :].set(jnp.asarray(bb.astype(xdt)))
+
+    for g in sched.groups:
+        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+        X = _fwd_group_T(X, lu.U_flat, lu.Ui_flat, col_idx, struct_idx,
+                         jnp.int32(g.U_off), jnp.int32(g.Ui_off),
+                         mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+    for g in reversed(sched.groups):
+        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+        X = _bwd_group_T(X, lu.L_flat, lu.Li_flat, col_idx, struct_idx,
+                         jnp.int32(g.L_off), jnp.int32(g.Li_off),
+                         mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+
+    out = np.asarray(X[:sched.n])
+    return out[:, 0] if squeeze else out
+
+
 # --------------------------------------------------------------------
 # fused whole-pipeline step (one XLA program)
 # --------------------------------------------------------------------
@@ -521,3 +603,162 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         return X[:sched.n]
 
     return step
+
+
+# --------------------------------------------------------------------
+# fused whole-driver solver: factor + solve + device-side refinement
+# --------------------------------------------------------------------
+
+def make_fused_solver(plan: FactorPlan, dtype=np.float32,
+                      refine_dtype=np.float64,
+                      max_steps: Optional[int] = None):
+    """Build `step(vals, b) -> (x, berr, steps, tiny, nzero)`: the
+    ENTIRE pdgssvx numeric pipeline as ONE XLA program — scale +
+    assemble + level-batched factorization in `dtype`, trisolve, then
+    iterative refinement with `refine_dtype` residual accumulation
+    entirely on device (pdgsrfs + pdgsmv, SRC/pdgsrfs.c:124,
+    SRC/pdgsmv.c; the mixed-precision strategy of psgssvx_d2,
+    SRC/psgssvx_d2.c:516).
+
+    `vals` are the UNSCALED matrix values in plan COO order and `b` is
+    the RHS in the ORIGINAL ordering, shape (n, nrhs) — scaling and
+    permutation gathers happen in-program, so one dispatch serves the
+    SamePattern production loop."""
+    from .spmv import coo_spmv
+
+    from ..options import IterRefine
+
+    sched = get_schedule(plan, 1)
+    dtype = np.dtype(dtype)
+    rdt = np.dtype(refine_dtype)
+    if dtype.kind == "c" and rdt.kind != "c":
+        # complex system: the accumulator keeps its precision but must
+        # be complex (mirror models/refine._refine_dtype)
+        rdt = np.promote_types(rdt, np.complex64)
+    if max_steps is None:
+        if plan.options.iter_refine == IterRefine.NOREFINE:
+            max_steps = 0
+        else:
+            max_steps = int(plan.options.max_refine_steps)
+    thresh_np = _thresh_for(plan, dtype)
+    n = plan.n
+
+    # refinement must run on the UNSCALED system (b - A·x in original
+    # ordering); precompute the permutation gathers host-side
+    inv_final_row = np.empty(n, dtype=np.int64)
+    inv_final_row[plan.final_row] = np.arange(n)
+
+    idt = jnp.int32 if n < 2**31 - 1 else jnp.int64
+    ops = dict(
+        scale_fac=jnp.asarray(
+            (plan.row_scale[plan.coo_rows]
+             * plan.col_scale[plan.coo_cols])),
+        row_scale=jnp.asarray(plan.row_scale),
+        col_scale=jnp.asarray(plan.col_scale),
+        final_col=jnp.asarray(plan.final_col, dtype=idt),
+        inv_final_row=jnp.asarray(inv_final_row, dtype=idt),
+        coo_rows=jnp.asarray(plan.coo_rows, dtype=idt),
+        coo_cols=jnp.asarray(plan.coo_cols, dtype=idt),
+    )
+
+    def _factor(scaled_vals):
+        thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
+        svals = jnp.concatenate(
+            [scaled_vals.astype(dtype), jnp.zeros(1, dtype)])
+        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+        flats = [jnp.zeros(sched.L_total, dtype),
+                 jnp.zeros(sched.U_total, dtype),
+                 jnp.zeros(sched.Li_total, dtype),
+                 jnp.zeros(sched.Ui_total, dtype)]
+        tiny = jnp.zeros((), jnp.int32)
+        nzero = jnp.zeros((), jnp.int32)
+        for g in sched.groups:
+            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = \
+                g.dev(squeeze=True)
+            (upd_buf, flats[0], flats[1], flats[2], flats[3], tiny,
+             nzero) = _factor_group_impl(
+                svals, upd_buf, flats[0], flats[1], flats[2], flats[3],
+                tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
+                ea_dst, jnp.int32(g.upd_off_global),
+                jnp.int32(g.L_off), jnp.int32(g.U_off),
+                jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
+                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        return flats, tiny, nzero
+
+    def _sweep(flats, bf):
+        """Triangular solves in factor ordering, factor dtype."""
+        L_flat, U_flat, Li_flat, Ui_flat = flats
+        X = jnp.zeros((n + 1, bf.shape[1]), bf.dtype)
+        X = X.at[:n, :].set(bf)
+        for g in sched.groups:
+            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
+                                struct_idx, jnp.int32(g.L_off),
+                                jnp.int32(g.Li_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        for g in reversed(sched.groups):
+            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
+                                struct_idx, jnp.int32(g.U_off),
+                                jnp.int32(g.Ui_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+        return X[:n]
+
+    def _solve_once(flats, r):
+        """r (original order, rdt) -> correction (original order, rdt);
+        sweeps run in factor precision like the reference's psgsrfs."""
+        bf = (r * ops["row_scale"][:, None])[ops["inv_final_row"]]
+        y = _sweep(flats, bf.astype(dtype))
+        return (y[ops["final_col"]].astype(rdt)
+                * ops["col_scale"][:, None])
+
+    def step(vals, b):
+        scaled = vals * ops["scale_fac"]
+        flats, tiny, nzero = _factor(scaled)
+        vals_r = vals.astype(rdt)
+        abs_vals = jnp.abs(vals_r)
+        b = b.astype(rdt)
+        x = _solve_once(flats, b)
+
+        def resid_berr(xv):
+            ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r,
+                          xv, n)
+            r = b - ax
+            denom = coo_spmv(ops["coo_rows"], ops["coo_cols"],
+                             abs_vals, jnp.abs(xv), n) + jnp.abs(b)
+            denom = jnp.where(denom == 0, 1, denom)
+            return r, jnp.max(jnp.abs(r) / denom)
+
+        if max_steps <= 0:
+            _, berr = resid_berr(x)
+            return x, berr, jnp.zeros((), jnp.int32), tiny, nzero
+
+        eps = float(np.finfo(rdt.char.lower()
+                             if rdt.kind == "c" else rdt).eps)
+        r0, berr0 = resid_berr(x)
+
+        def cond(state):
+            _, _, berr, _, stop = state
+            return jnp.logical_and(jnp.logical_not(stop), berr > eps)
+
+        def body(state):
+            x, r, berr, steps, _ = state
+            d = _solve_once(flats, r)
+            x_new = x + d
+            r_new, berr_new = resid_berr(x_new)
+            improved = berr_new < berr * 0.5
+            better = berr_new < berr
+            x = jnp.where(better, x_new, x)
+            r = jnp.where(better, r_new, r)
+            berr = jnp.where(better, berr_new, berr)
+            stop = jnp.logical_or(jnp.logical_not(improved),
+                                  steps + 1 >= max_steps)
+            return x, r, berr, steps + 1, stop
+
+        x, _, berr, steps, _ = jax.lax.while_loop(
+            cond, body,
+            (x, r0, berr0, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.bool_)))
+        return x, berr, steps, tiny, nzero
+
+    return jax.jit(step)

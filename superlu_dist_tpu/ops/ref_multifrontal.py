@@ -133,3 +133,39 @@ def solve_host(lu: HostLU, b: np.ndarray) -> np.ndarray:
         x[first:last] = lu.Uinv[s] @ rhs
 
     return x[:, 0] if squeeze else x
+
+
+def solve_host_trans(lu: HostLU, b: np.ndarray) -> np.ndarray:
+    """Solve Mᵀ·x = b where M = L·U is the factored matrix (factor
+    ordering).  Mᵀ = Uᵀ·Lᵀ: forward sweep on the lower-triangular Uᵀ,
+    backward on the unit-upper Lᵀ — the pdgstrs TRANS contract
+    (SRC/pdgstrs.c trans branch) expressed panel-wise."""
+    plan = lu.plan
+    fp = plan.frontal
+    part = fp.sym.part
+    xsup = part.xsup
+    ns = fp.nsuper
+    xdt = np.promote_types(lu.L[0].dtype if ns else b.dtype, b.dtype)
+    x = b.astype(xdt)
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[:, None]
+
+    # forward with Uᵀ: diag block (U_ss)ᵀ, sub-block (U panel cols)ᵀ
+    for s in range(ns):
+        first, last = int(xsup[s]), int(xsup[s + 1])
+        w = int(fp.w[s])
+        y1 = lu.Uinv[s].T @ x[first:last]
+        x[first:last] = y1
+        if fp.r[s]:
+            x[fp.sym.struct[s]] -= lu.U[s][:, w:].T @ y1
+    # backward with Lᵀ (unit upper)
+    for s in range(ns - 1, -1, -1):
+        first, last = int(xsup[s]), int(xsup[s + 1])
+        w = int(fp.w[s])
+        rhs = x[first:last]
+        if fp.r[s]:
+            rhs = rhs - lu.L[s][w:].T @ x[fp.sym.struct[s]]
+        x[first:last] = lu.Linv[s].T @ rhs
+
+    return x[:, 0] if squeeze else x

@@ -45,11 +45,22 @@ from ..ops.batched import (_bwd_group_impl, _factor_group_impl,
 
 
 def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
-                   axis: str = "z"):
+                   axis=None):
     """Build the distributed factor+solve step: `step(vals, b) -> x`,
-    shard_map'd over `mesh` axis `axis` and jitted as one program.
-    `vals` in plan COO order; `b` (n, nrhs) in factor ordering."""
-    ndev = mesh.shape[axis]
+    shard_map'd over `mesh` and jitted as one program.  `axis` is a
+    mesh axis name or tuple of names to partition fronts over; default
+    is ALL of the mesh's axes (the 3D (r,c,z) grid flattens onto one
+    front partition — the reference's 2D block-cyclic × Z-replication
+    becomes a single linearized device dimension, since XLA collectives
+    take axis-name tuples and ride ICI either way).  `vals` in plan COO
+    order; `b` (n, nrhs) in factor ordering."""
+    if axis is None:
+        axis = tuple(mesh.axis_names)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+        ndev = int(np.prod([mesh.shape[a] for a in axis]))
+    else:
+        ndev = mesh.shape[axis]
     dsched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)

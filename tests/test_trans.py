@@ -1,0 +1,72 @@
+"""Transpose and conjugate-transpose solves (the pdgssvx `trans`
+contract).  Oracle: scipy dense solve of op(A)·x = b."""
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Fact, Options, Trans, factorize, gssvx, solve
+from superlu_dist_tpu.utils.testmat import (helmholtz_2d, laplacian_2d,
+                                            random_unsymmetric)
+
+
+def _relres(op_a, x, b):
+    return np.linalg.norm(op_a @ x - b) / np.linalg.norm(b)
+
+
+@pytest.mark.parametrize("backend", ["host", "jax"])
+def test_trans_real(backend):
+    a = random_unsymmetric(60, seed=2)
+    asp = a.to_scipy()
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal((a.n, 2))
+    lu = factorize(a, Options(), backend=backend)
+    # NOTRANS sanity, then TRANS against Aᵀ
+    x0 = solve(lu, b)
+    assert _relres(asp, x0, b) < 1e-12
+    lu.options = lu.effective_options.replace(trans=Trans.TRANS)
+    xt = solve(lu, b)
+    assert _relres(asp.T, xt, b) < 1e-12
+
+
+@pytest.mark.parametrize("backend", ["host", "jax"])
+@pytest.mark.parametrize("trans", [Trans.TRANS, Trans.CONJ])
+def test_trans_complex(backend, trans):
+    a = helmholtz_2d(6)
+    asp = a.to_scipy()
+    rng = np.random.default_rng(1)
+    b = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
+    lu = factorize(a, Options(factor_dtype="complex128"), backend=backend)
+    lu.options = lu.effective_options.replace(trans=trans)
+    x = solve(lu, b)
+    op = asp.T if trans == Trans.TRANS else asp.conj().T
+    assert _relres(op, x, b) < 1e-10
+
+
+def test_trans_via_gssvx_factored_rung():
+    """FACTORED reuse honors the caller's trans knob."""
+    a = laplacian_2d(7)
+    # break symmetry so TRANS is distinguishable
+    av = a.data.copy()
+    av[::7] *= 1.7
+    import dataclasses
+    a = dataclasses.replace(a, data=av)
+    asp = a.to_scipy()
+    b = np.arange(1.0, a.n + 1.0)
+    x0, lu, _ = gssvx(Options(), a, b, backend="host")
+    assert _relres(asp, x0, b) < 1e-12
+    xt, _, stats = gssvx(Options(fact=Fact.FACTORED, trans=Trans.TRANS),
+                         a, b, lu=lu, backend="host")
+    assert _relres(asp.T, xt, b) < 1e-12
+    assert stats.berr < 1e-14
+
+
+@pytest.mark.parametrize("backend", ["host", "jax"])
+def test_trans_refinement_mixed_precision(backend):
+    """f32 factor + f64 refinement must reach f64 accuracy for Aᵀ."""
+    a = random_unsymmetric(80, seed=5)
+    asp = a.to_scipy()
+    b = np.ones(a.n)
+    lu = factorize(a, Options(factor_dtype="float32"), backend=backend)
+    lu.options = lu.effective_options.replace(trans=Trans.TRANS)
+    x = solve(lu, b)
+    assert _relres(asp.T, x, b) < 1e-12
