@@ -1,0 +1,1 @@
+"""Command-line drivers (EXAMPLE/pddrive*.c analogs)."""

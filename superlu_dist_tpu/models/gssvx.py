@@ -94,6 +94,8 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             raise ValueError(f"unknown backend {backend!r}")
     lu.options = options
     stats.add_ops("FACT", plan.factor_flops)
+    stats.lu_nnz = plan.lu_nnz()
+    stats.lu_bytes = stats.lu_nnz * np.dtype(options.factor_dtype).itemsize
     return lu
 
 
@@ -184,6 +186,50 @@ def solve(lu: LUFactorization, b: np.ndarray,
         stats.refine_steps += steps
 
     return x[:, 0] if squeeze else x
+
+
+def get_diag_u(lu: LUFactorization) -> np.ndarray:
+    """Diagonal of U in FACTOR column order (pdGetDiagU analog,
+    SRC/pdGetDiagU.c).  diag(U)[final_col[j]] is original column j's
+    pivot."""
+    plan = lu.plan
+    fp = plan.frontal
+    xsup = fp.sym.part.xsup
+    out = np.empty(plan.n, dtype=np.dtype(
+        lu.effective_options.factor_dtype))
+    if lu.backend == "host":
+        for s in range(fp.nsuper):
+            w = int(fp.w[s])
+            hu = lu.host_lu.U[s]
+            out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(hu[:w, :w])
+        return out
+    U_flat = np.asarray(lu.device_lu.U_flat)
+    for g in lu.device_lu.schedule.groups:
+        panel = U_flat[g.U_off:g.U_off + g.n_loc * g.wb * g.mb]
+        panel = panel.reshape(g.n_loc, g.wb, g.mb)
+        for b, s in enumerate(g.sup_ids):
+            w = int(fp.w[s])
+            out[int(xsup[s]):int(xsup[s]) + w] = \
+                np.diagonal(panel[b])[:w]
+    return out
+
+
+def query_space(lu: LUFactorization) -> dict:
+    """LU storage accounting (dQuerySpace_dist analog,
+    SRC/superlu_ddefs.h:616): true nnz(L+U) and the bytes actually
+    held (padded slabs on device, unpadded panels on host)."""
+    itemsize = np.dtype(lu.effective_options.factor_dtype).itemsize
+    nnz = lu.plan.lu_nnz()
+    if lu.backend == "host":
+        held = sum(p.nbytes for s in (lu.host_lu.L, lu.host_lu.U,
+                                      lu.host_lu.Linv, lu.host_lu.Uinv)
+                   for p in s)
+    else:
+        d = lu.device_lu
+        held = (d.L_flat.size + d.U_flat.size + d.Li_flat.size
+                + d.Ui_flat.size) * itemsize
+    return {"lu_nnz": nnz, "lu_bytes": nnz * itemsize,
+            "held_bytes": int(held)}
 
 
 def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,

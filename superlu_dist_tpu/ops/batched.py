@@ -610,7 +610,7 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
 # --------------------------------------------------------------------
 
 def make_fused_solver(plan: FactorPlan, dtype=np.float32,
-                      refine_dtype=np.float64,
+                      refine_dtype=None,
                       max_steps: Optional[int] = None):
     """Build `step(vals, b) -> (x, berr, steps, tiny, nzero)`: the
     ENTIRE pdgssvx numeric pipeline as ONE XLA program — scale +
@@ -630,6 +630,14 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
 
     sched = get_schedule(plan, 1)
     dtype = np.dtype(dtype)
+    if refine_dtype is None:
+        # honor the plan's refinement contract (models/refine.py):
+        # SLU_SINGLE accumulates in the working precision, otherwise in
+        # options.refine_dtype
+        if plan.options.iter_refine == IterRefine.SLU_SINGLE:
+            refine_dtype = dtype
+        else:
+            refine_dtype = plan.options.refine_dtype
     rdt = np.dtype(refine_dtype)
     if dtype.kind == "c" and rdt.kind != "c":
         # complex system: the accumulator keeps its precision but must
@@ -653,8 +661,10 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         scale_fac=jnp.asarray(
             (plan.row_scale[plan.coo_rows]
              * plan.col_scale[plan.coo_cols])),
-        row_scale=jnp.asarray(plan.row_scale),
-        col_scale=jnp.asarray(plan.col_scale),
+        row_scale=jnp.asarray(plan.row_scale.astype(
+            _real_dtype(rdt))),
+        col_scale=jnp.asarray(plan.col_scale.astype(
+            _real_dtype(rdt))),
         final_col=jnp.asarray(plan.final_col, dtype=idt),
         inv_final_row=jnp.asarray(inv_final_row, dtype=idt),
         coo_rows=jnp.asarray(plan.coo_rows, dtype=idt),

@@ -114,7 +114,11 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
 
 def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
     """vmapped partial_lu over a batch of fronts (N, mb, mb).
-    Returns (F', tiny_count, zero_pivot_count)."""
+    Returns (F', tiny_count, zero_pivot_count).  Dispatches to the
+    VMEM-resident Pallas kernel when enabled (ops/pallas_lu.py)."""
+    from . import pallas_lu
+    if pallas_lu.enabled(F.dtype):
+        return pallas_lu.partial_lu_batch_pallas(F, thresh, wb=wb)
     f = functools.partial(partial_lu, wb=wb, nb=nb)
     Fs, tinys, nzeros = jax.vmap(lambda x: f(x, thresh))(F)
     return Fs, jnp.sum(tinys), jnp.sum(nzeros)

@@ -1,0 +1,121 @@
+"""Pallas TPU kernel: batched partial LU of front panels in VMEM.
+
+The XLA formulation (ops/dense_lu.py) carries the front through a
+fori_loop in HBM — every column step is a separate fused kernel with an
+HBM round-trip.  This kernel keeps the whole (mb × mb) front VMEM-
+resident for the entire wb-column elimination (the analog of the
+reference keeping the panel in GPU shared memory across
+Local_Dgstrf2's column loop, SRC/pdgstrf2.c:404), so the per-column
+cost is pure VPU work:
+
+    column k:  extract col/row k by iota-mask reduction (no dynamic
+               lane slicing), tiny-pivot replace, scale below-diagonal,
+               masked rank-1 outer-product update of the trailing block
+
+Gating: off by default until validated on real hardware; enable with
+SLU_TPU_PALLAS=1 (force, any platform via interpret on CPU) — see
+`enabled()`.  Semantics match ops/dense_lu.partial_lu exactly
+(tests/test_pallas.py compares them elementwise).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def enabled(dtype) -> bool:
+    """Use the Pallas kernel?  SLU_TPU_PALLAS=1 forces on (interpret
+    mode off-TPU), =0 forces off; default off pending hardware
+    validation.  Complex dtypes always use the XLA path (no complex in
+    Mosaic)."""
+    if not _HAVE_PALLAS:
+        return False
+    if np.dtype(dtype).kind == "c":
+        return False
+    flag = os.environ.get("SLU_TPU_PALLAS", "0")
+    return flag == "1"
+
+
+def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
+               wb: int, mb: int):
+    F = F_ref[0]
+    dtype = F.dtype
+    thresh = thresh_ref[0, 0].astype(dtype)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (mb, mb), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (mb, mb), 1)
+
+    def col_step(k, carry):
+        F, tiny, nzero = carry
+        is_k_col = cols == k
+        is_k_row = rows == k
+        # column/row k via mask-reduce (dynamic lane slicing is slow)
+        ck = jnp.sum(jnp.where(is_k_col, F, 0), axis=1, keepdims=True)
+        piv = jnp.sum(jnp.where(is_k_col & is_k_row, F, 0))
+        apiv = jnp.abs(piv)
+        is_tiny = apiv < thresh
+        sgn = jnp.where(piv >= 0, jnp.ones((), dtype),
+                        -jnp.ones((), dtype))
+        piv = jnp.where(is_tiny, sgn * thresh, piv)
+        was_zero = jnp.logical_and(apiv == 0, jnp.logical_not(is_tiny))
+        below = rows[:, :1] > k
+        scaled = jnp.where(below, ck / piv, ck)
+        newcol = jnp.where(is_k_row[:, :1], piv, scaled)
+        F = jnp.where(is_k_col, newcol, F)
+        rk = jnp.sum(jnp.where(is_k_row, F, 0), axis=0, keepdims=True)
+        upd = jnp.where(below, scaled, 0) @ jnp.where(
+            cols[:1, :] > k, rk, 0)
+        F = F - upd
+        return (F, tiny + is_tiny.astype(jnp.int32),
+                nzero + was_zero.astype(jnp.int32))
+
+    zero = jnp.zeros((), jnp.int32)
+    F, tiny, nzero = jax.lax.fori_loop(0, wb, col_step, (F, zero, zero))
+    out_ref[0] = F
+    tiny_ref[0] = tiny
+    nzero_ref[0] = nzero
+
+
+def partial_lu_batch_pallas(F, thresh, *, wb: int,
+                            interpret: bool | None = None):
+    """Drop-in for dense_lu.partial_lu_batch: F (N, mb, mb) ->
+    (F', tiny_total, nzero_total)."""
+    N, mb, _ = F.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    thresh_arr = jnp.asarray(thresh, dtype=F.dtype).reshape(1, 1)
+    kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
+    out, tiny, nzero = pl.pallas_call(
+        kern,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, mb, mb), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, mb, mb), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, mb, mb), F.dtype),
+            jax.ShapeDtypeStruct((N,), jnp.int32),
+            jax.ShapeDtypeStruct((N,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(thresh_arr, F)
+    return out, jnp.sum(tiny), jnp.sum(nzero)

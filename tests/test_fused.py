@@ -101,3 +101,26 @@ def test_fused_solver_respects_norefine():
     step = make_fused_solver(plan, dtype="float64")
     _, _, steps, *_ = step(jnp.asarray(a.data), jnp.asarray(b[:, None]))
     assert int(steps) == 0
+
+
+def test_fused_solver_slu_single_accumulates_in_working_precision():
+    from superlu_dist_tpu.options import IterRefine
+    a = laplacian_2d(6)
+    plan = plan_factorization(
+        a, Options(factor_dtype="float32",
+                   iter_refine=IterRefine.SLU_SINGLE))
+    _, b = manufactured_rhs(a)
+    step = make_fused_solver(plan, dtype="float32")
+    x, berr, *_ = step(jnp.asarray(a.data), jnp.asarray(b[:, None]))
+    # f32 accumulator: berr bottoms out near f32 eps, not f64 eps
+    assert float(berr) < 1e-5
+    assert np.asarray(x).dtype == np.float32
+
+
+def test_pddrive_fused_rejects_trans(tmp_path):
+    from superlu_dist_tpu.drivers import pddrive
+    from superlu_dist_tpu.utils.io import write_binary
+    p = tmp_path / "m.bin"
+    write_binary(str(p), laplacian_2d(5))
+    with pytest.raises(SystemExit):
+        pddrive.main([str(p), "--fused", "--trans", "TRANS", "-q"])
