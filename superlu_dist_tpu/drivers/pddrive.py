@@ -72,6 +72,11 @@ def main(argv=None) -> int:
 
     complex_sys = np.issubdtype(a.dtype, np.complexfloating)
     fdt = args.dtype or ("complex128" if complex_sys else "float64")
+    if complex_sys and np.dtype(fdt).kind != "c":
+        # map the real mixed-precision request to its complex analog
+        fdt = np.promote_types(np.dtype(fdt), np.complex64).name
+        if not args.quiet:
+            print(f"complex matrix: factor dtype mapped to {fdt}")
     opts = Options(
         factor_dtype=fdt,
         equil=not args.no_equil,
@@ -94,6 +99,9 @@ def main(argv=None) -> int:
     stats = Stats()
     nproc = args.nprow * args.npcol * args.npdep
     if nproc > 1:
+        if args.backend != "auto" or args.fused:
+            raise SystemExit("-r/-c/-d > 1 selects the distributed "
+                             "backend; drop --backend/--fused")
         x = _solve_distributed(a, b, opts, args, stats)
     elif args.fused:
         x = _solve_fused(a, b, opts, stats)
@@ -133,24 +141,10 @@ def _solve_fused(a, b, opts, stats):
 
 
 def _solve_distributed(a, b, opts, args, stats):
-    from ..parallel.factor_dist import make_dist_step
     from ..parallel.grid import make_solver_mesh
-    from ..plan.plan import plan_factorization
 
-    if opts.trans != Trans.NOTRANS:
-        raise SystemExit("distributed trans solve: use the single-"
-                         "device path (-r 1 -c 1 -d 1)")
     g = make_solver_mesh(args.nprow, args.npcol, args.npdep)
-    plan = plan_factorization(a, opts, stats=stats)
-    step, _ = make_dist_step(plan, g.mesh,
-                             dtype=np.dtype(opts.factor_dtype))
-    bf = np.empty_like(b)
-    bf[plan.final_row] = b * plan.row_scale[:, None]
-    with stats.timer("FACT"):
-        y = step(plan.scaled_values(a), bf)
-        y.block_until_ready()
-    stats.add_ops("FACT", plan.factor_flops)
-    x = np.asarray(y)[plan.final_col] * plan.col_scale[:, None]
+    x, _, _ = gssvx(opts, a, b, stats=stats, grid=g)
     return x
 
 

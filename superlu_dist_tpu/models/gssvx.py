@@ -58,7 +58,8 @@ def factorize(a: CSRMatrix, options: Options | None = None,
               stats: Stats | None = None,
               backend: str = "auto",
               user_perm_r: np.ndarray | None = None,
-              user_perm_c: np.ndarray | None = None) -> LUFactorization:
+              user_perm_c: np.ndarray | None = None,
+              grid=None) -> LUFactorization:
     # caller's options win (numeric knobs may differ from the cached
     # plan's); fall back to the plan's when none are given
     if options is None:
@@ -69,12 +70,26 @@ def factorize(a: CSRMatrix, options: Options | None = None,
                                   user_perm_r=user_perm_r,
                                   user_perm_c=user_perm_c)
     scaled = plan.scaled_values(a)
+    # a complex system forces a complex factor dtype of matching
+    # precision (the reference's z drivers hard-code doublecomplex; a
+    # silent cast would truncate imaginary parts)
+    fdt = np.dtype(options.factor_dtype)
+    if np.issubdtype(a.dtype, np.complexfloating) and fdt.kind != "c":
+        fdt = np.promote_types(fdt, np.complex64)
+        options = options.replace(factor_dtype=fdt.name)
     if backend == "auto":
-        try:
-            from ..ops import batched  # noqa: F401
-            backend = "jax"
-        except ImportError:
-            backend = "host"
+        if grid is not None:
+            backend = "dist"
+        else:
+            try:
+                from ..ops import batched  # noqa: F401
+                backend = "jax"
+            except ImportError:
+                backend = "host"
+    elif backend != "dist" and grid is not None:
+        raise ValueError(
+            f"backend={backend!r} conflicts with grid=; pass "
+            "backend='dist' (or 'auto') for mesh execution")
 
     with stats.timer("FACT"):
         if backend == "host":
@@ -90,6 +105,24 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             stats.tiny_pivots += int(device_lu.tiny_pivots)
             lu = LUFactorization(plan=plan, backend="jax",
                                  device_lu=device_lu, a=a, stats=stats)
+        elif backend == "dist":
+            # mesh-sharded factors (pdgssvx on a process grid); `grid`
+            # is a parallel.grid.Grid/Grid3D or a jax Mesh
+            from ..parallel import factor_dist
+            if grid is None:
+                raise ValueError("backend='dist' requires grid=")
+            mesh = getattr(grid, "mesh", grid)
+            cache = getattr(plan, "_dist_factor_fns", None)
+            if cache is None:
+                cache = plan._dist_factor_fns = {}
+            key = (mesh, np.dtype(options.factor_dtype).str)
+            if key not in cache:
+                cache[key] = factor_dist.make_dist_factor(
+                    plan, mesh, dtype=np.dtype(options.factor_dtype))
+            dist_lu = cache[key](scaled)
+            stats.tiny_pivots += dist_lu.tiny_pivots
+            lu = LUFactorization(plan=plan, backend="dist",
+                                 device_lu=dist_lu, a=a, stats=stats)
         else:
             raise ValueError(f"unknown backend {backend!r}")
     lu.options = options
@@ -103,6 +136,10 @@ def _solve_factored(lu: LUFactorization, b_factor_order: np.ndarray):
     """Triangular solves in factor ordering/scaling."""
     if lu.backend == "host":
         return ref_multifrontal.solve_host(lu.host_lu, b_factor_order)
+    if lu.backend == "dist":
+        from ..parallel import factor_dist
+        return np.asarray(factor_dist.dist_solve(lu.device_lu,
+                                                 b_factor_order))
     from ..ops import batched
     return batched.solve_device(lu.device_lu, b_factor_order)
 
@@ -112,6 +149,10 @@ def _solve_factored_trans(lu: LUFactorization, b_factor_order: np.ndarray):
     if lu.backend == "host":
         return ref_multifrontal.solve_host_trans(lu.host_lu,
                                                  b_factor_order)
+    if lu.backend == "dist":
+        from ..parallel import factor_dist
+        return np.asarray(factor_dist.dist_solve(
+            lu.device_lu, b_factor_order, trans=True))
     from ..ops import batched
     return batched.solve_device_trans(lu.device_lu, b_factor_order)
 
@@ -203,14 +244,18 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
             hu = lu.host_lu.U[s]
             out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(hu[:w, :w])
         return out
+    sched = lu.device_lu.schedule
     U_flat = np.asarray(lu.device_lu.U_flat)
-    for g in lu.device_lu.schedule.groups:
-        panel = U_flat[g.U_off:g.U_off + g.n_loc * g.wb * g.mb]
-        panel = panel.reshape(g.n_loc, g.wb, g.mb)
-        for b, s in enumerate(g.sup_ids):
+    # dist flats are the ndev-concatenated device-major slabs; the
+    # single-device case is ndev=1 of the same layout
+    U_total = U_flat.size // sched.ndev
+    for g in sched.groups:
+        for bg, s in enumerate(g.sup_ids):
+            d, b = divmod(bg, g.n_loc)
+            base = d * U_total + g.U_off + b * g.wb * g.mb
+            panel = U_flat[base:base + g.wb * g.mb].reshape(g.wb, g.mb)
             w = int(fp.w[s])
-            out[int(xsup[s]):int(xsup[s]) + w] = \
-                np.diagonal(panel[b])[:w]
+            out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(panel)[:w]
     return out
 
 
@@ -236,7 +281,8 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
           stats: Stats | None = None, backend: str = "auto",
           lu: LUFactorization | None = None,
           user_perm_r: np.ndarray | None = None,
-          user_perm_c: np.ndarray | None = None):
+          user_perm_c: np.ndarray | None = None,
+          grid=None):
     """One-call driver.  Returns (x, lu, stats).  Pass `lu` with
     options.fact=FACTORED to reuse a prior factorization, or with
     options.fact=SAME_PATTERN* to re-factor new values reusing the
@@ -266,15 +312,17 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
         opts2 = options.replace(col_perm=ColPerm.MY_PERMC)
         plan = plan_factorization(a, opts2, stats=stats,
                                   user_perm_c=lu.plan.perm_c)
-        lu = factorize(a, opts2, plan=plan, stats=stats, backend=backend)
+        lu = factorize(a, opts2, plan=plan, stats=stats, backend=backend,
+                       grid=grid)
     elif (lu is not None
           and options.fact == Fact.SAME_PATTERN_SAME_ROWPERM):
         # reuse perms, scalings and the whole symbolic plan; refresh
         # numeric values only
         lu = factorize(a, options, plan=lu.plan, stats=stats,
-                       backend=backend)
+                       backend=backend, grid=grid)
     else:
         lu = factorize(a, options, stats=stats, backend=backend,
-                       user_perm_r=user_perm_r, user_perm_c=user_perm_c)
+                       user_perm_r=user_perm_r, user_perm_c=user_perm_c,
+                       grid=grid)
     x = solve(lu, b, stats=stats)
     return x, lu, stats

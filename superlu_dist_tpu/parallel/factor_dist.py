@@ -5,11 +5,11 @@ where pdgstrf (SRC/pdgstrf.c:1108) drives 2D block-cyclic panels with
 MPI point-to-point and pdgstrf3d (SRC/pdgstrf3d.c:292) adds Z-axis
 subtree replication with pairwise ancestor reductions
 (dreduceAncestors3d, SRC/pd3dcomm.c:704), this build shards every
-elimination-tree level's bucketed front batch across a mesh axis and
+elimination-tree level's bucketed front batch across the mesh and
 expresses the cross-process dataflow as XLA collectives inside ONE
 compiled program:
 
-  * front batches: block-partitioned over the mesh axis 'z'
+  * front batches: block-partitioned over the mesh axes
     (ops/batched.build_schedule(plan, ndev) — the same builder as the
     single-device path, so the oracle and the distributed path cannot
     diverge);
@@ -20,18 +20,24 @@ compiled program:
     (the C_Tree bcast/reduce forest of pdgstrs, SRC/pdgstrs.c:2133,
     collapsed into level-synchronous collectives);
   * factor panels stay device-resident and device-sharded (the
-    dLocalLU_t distribution, SRC/superlu_ddefs.h:97-263).
+    dLocalLU_t distribution, SRC/superlu_ddefs.h:97-263) — `DistLU`
+    persists them across solves, the distributed FACTORED rung.
 
 The per-group bodies are literally ops.batched's `_factor_group_impl` /
-`_fwd_group_impl` / `_bwd_group_impl` with `axis='z'` — one
-implementation serves both execution modes by construction.
+`_fwd_group_impl` / `_bwd_group_impl` with a mesh axis — one
+implementation serves all execution modes by construction, and the
+`_factor_loop`/`_solve_loop` helpers below are the single source of
+the group iteration shared by the fused step and the split
+factor/solve pair.
 
-Everything is shard_map'd over `Mesh(axis='z')`, so the same program
-runs on 1 device (degenerate), an 8-device CPU mesh (tests), or a TPU
-pod slice (ICI collectives).
+Everything is shard_map'd over the mesh, so the same program runs on 1
+device (degenerate), an 8-device CPU mesh (tests), or a TPU pod slice
+(ICI collectives).
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -39,21 +45,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..plan.plan import FactorPlan
-from ..ops.batched import (_bwd_group_impl, _factor_group_impl,
-                           _fwd_group_impl, _real_dtype, _thresh_for,
+from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl,
+                           _factor_group_impl, _fwd_group_impl,
+                           _fwd_group_T_impl, _real_dtype, _thresh_for,
                            get_schedule)
 
 
-def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
-                   axis=None):
-    """Build the distributed factor+solve step: `step(vals, b) -> x`,
-    shard_map'd over `mesh` and jitted as one program.  `axis` is a
-    mesh axis name or tuple of names to partition fronts over; default
-    is ALL of the mesh's axes (the 3D (r,c,z) grid flattens onto one
-    front partition — the reference's 2D block-cyclic × Z-replication
-    becomes a single linearized device dimension, since XLA collectives
-    take axis-name tuples and ride ICI either way).  `vals` in plan COO
-    order; `b` (n, nrhs) in factor ordering."""
+def _resolve_axis(mesh: Mesh, axis):
     if axis is None:
         axis = tuple(mesh.axis_names)
     if isinstance(axis, (list, tuple)):
@@ -61,68 +59,217 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         ndev = int(np.prod([mesh.shape[a] for a in axis]))
     else:
         ndev = mesh.shape[axis]
+    return axis, ndev
+
+
+def _regroup(dsched, idx_flat, per):
+    """Flat shard_map operand list -> per-group tuples, leading
+    device-block dim stripped."""
+    it = iter(idx_flat)
+    return [tuple(next(it)[0] for _ in range(per))
+            for _ in dsched.groups]
+
+
+def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
+    """Shared factorization group loop (runs inside shard_map)."""
+    thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
+    vals = jnp.concatenate([vals.astype(dtype), jnp.zeros(1, dtype)])
+    upd_buf = jnp.zeros(dsched.upd_total + 1, dtype)
+    L_flat = jnp.zeros(dsched.L_total, dtype)
+    U_flat = jnp.zeros(dsched.U_total, dtype)
+    Li_flat = jnp.zeros(dsched.Li_total, dtype)
+    Ui_flat = jnp.zeros(dsched.Ui_total, dtype)
+    tiny = jnp.zeros((), jnp.int32)
+    nzero = jnp.zeros((), jnp.int32)
+    for g, idx in zip(dsched.groups, per_group):
+        a_src, a_dst, one_dst, ea_src, ea_dst = idx[:5]
+        (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+         nzero) = _factor_group_impl(
+            vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+            nzero, thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
+            jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
+            jnp.int32(g.U_off), jnp.int32(g.Li_off),
+            jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+            axis=axis)
+    return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
+
+
+def _solve_loop(dsched, flats, b, dtype, per_group, axis,
+                trans: bool):
+    """Shared triangular-sweep loop (runs inside shard_map).
+    `per_group` entries are (col_idx, struct_idx) pairs."""
+    L_flat, U_flat, Li_flat, Ui_flat = flats
+    n = dsched.n
+    xdt = jnp.promote_types(dtype, b.dtype)
+    X = jnp.zeros((n + 1, b.shape[1]), xdt)
+    X = X.at[:n, :].set(b.astype(xdt))
+    if not trans:
+        for g, (ci, si) in zip(dsched.groups, per_group):
+            X = _fwd_group_impl(X, L_flat, Li_flat, ci, si,
+                                jnp.int32(g.L_off), jnp.int32(g.Li_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                axis=axis)
+        for g, (ci, si) in zip(reversed(dsched.groups),
+                               reversed(per_group)):
+            X = _bwd_group_impl(X, U_flat, Ui_flat, ci, si,
+                                jnp.int32(g.U_off), jnp.int32(g.Ui_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                axis=axis)
+    else:
+        for g, (ci, si) in zip(dsched.groups, per_group):
+            X = _fwd_group_T_impl(X, U_flat, Ui_flat, ci, si,
+                                  jnp.int32(g.U_off),
+                                  jnp.int32(g.Ui_off), mb=g.mb,
+                                  wb=g.wb, n_pad=g.n_loc, axis=axis)
+        for g, (ci, si) in zip(reversed(dsched.groups),
+                               reversed(per_group)):
+            X = _bwd_group_T_impl(X, L_flat, Li_flat, ci, si,
+                                  jnp.int32(g.L_off),
+                                  jnp.int32(g.Li_off), mb=g.mb,
+                                  wb=g.wb, n_pad=g.n_loc, axis=axis)
+    return X[:n]
+
+
+def _group_operands(dsched, fields):
+    """(specs, args) for the given GroupSpec.dev tuple positions."""
+    group_idx = [g.dev(squeeze=False) for g in dsched.groups]
+    args = tuple(t[i] for t in group_idx for i in fields)
+    return args
+
+
+def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
+                   axis=None):
+    """Build the fused distributed factor+solve step:
+    `step(vals, b) -> x`, shard_map'd over `mesh` and jitted as one
+    program.  `axis` is a mesh axis name or tuple (default: ALL axes —
+    the 3D (r,c,z) grid flattens onto one front partition).  `vals` in
+    plan COO order; `b` (n, nrhs) in factor ordering."""
+    axis, ndev = _resolve_axis(mesh, axis)
     dsched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
-    n = dsched.n
 
-    group_idx = [g.dev(squeeze=False) for g in dsched.groups]
+    idx_args = _group_operands(dsched, range(7))
+    idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, b, *idx_flat):
-        # regroup the flat operand list into per-group 7-tuples and
-        # strip the leading device-block dim shard_map leaves
-        it = iter(idx_flat)
-        per_group = [tuple(next(it)[0] for _ in range(7))
-                     for _ in dsched.groups]
-
-        thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
-        vals = jnp.concatenate([vals.astype(dtype),
-                                jnp.zeros(1, dtype)])
-        upd_buf = jnp.zeros(dsched.upd_total + 1, dtype)
-        L_flat = jnp.zeros(dsched.L_total, dtype)
-        U_flat = jnp.zeros(dsched.U_total, dtype)
-        Li_flat = jnp.zeros(dsched.Li_total, dtype)
-        Ui_flat = jnp.zeros(dsched.Ui_total, dtype)
-        tiny = jnp.zeros((), jnp.int32)
-        nzero = jnp.zeros((), jnp.int32)
-        for g, idx in zip(dsched.groups, per_group):
-            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = idx
-            (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
-             nzero) = _factor_group_impl(
-                vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
-                tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
-                ea_dst, jnp.int32(g.upd_off_global),
-                jnp.int32(g.L_off), jnp.int32(g.U_off),
-                jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
-                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
-
-        xdt = jnp.promote_types(dtype, b.dtype)
-        X = jnp.zeros((n + 1, b.shape[1]), xdt)
-        X = X.at[:n, :].set(b.astype(xdt))
-        for g, idx in zip(dsched.groups, per_group):
-            X = _fwd_group_impl(
-                X, L_flat, Li_flat, idx[5], idx[6],
-                jnp.int32(g.L_off), jnp.int32(g.Li_off),
-                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
-        for g, idx in zip(reversed(dsched.groups),
-                          reversed(per_group)):
-            X = _bwd_group_impl(
-                X, U_flat, Ui_flat, idx[5], idx[6],
-                jnp.int32(g.U_off), jnp.int32(g.Ui_off),
-                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
-        return X[:n]
-
-    idx_specs = tuple(P(axis) for _ in dsched.groups for _ in range(7))
-    idx_args = tuple(a for t in group_idx for a in t)
+        per_group = _regroup(dsched, idx_flat, 7)
+        flats = _factor_loop(dsched, vals, thresh_np, dtype,
+                             per_group, axis)[:4]
+        solve_idx = [(t[5], t[6]) for t in per_group]
+        return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
+                           trans=False)
 
     mapped = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), P()) + idx_specs,
-        out_specs=P(),
-        check_vma=False)
+        body, mesh=mesh, in_specs=(P(), P()) + idx_specs,
+        out_specs=P(), check_vma=False)
 
     @jax.jit
     def step(vals, b):
         return mapped(vals, b, *idx_args)
 
     return step, dsched
+
+
+# --------------------------------------------------------------------
+# split factor / solve: persistent device-sharded factors — the
+# distributed FACTORED reuse rung (LUstruct persisting across pdgstrs
+# calls, SRC/superlu_defs.h:577-598)
+# --------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DistLU:
+    """Factor slabs sharded over the mesh (dLocalLU_t analog: each
+    device holds its front partition's panels; flats are the
+    ndev-concatenated global arrays, device-major)."""
+    plan: FactorPlan
+    mesh: Mesh
+    axis: object
+    dtype: np.dtype
+    schedule: object       # ops.batched.BatchedSchedule for ndev
+    L_flat: jnp.ndarray    # (ndev * L_total_local,), sharded on axis
+    U_flat: jnp.ndarray
+    Li_flat: jnp.ndarray
+    Ui_flat: jnp.ndarray
+    tiny_pivots: int
+
+
+def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
+                     axis=None):
+    """Build `factor(vals) -> DistLU` with mesh-sharded factor slabs.
+    `vals` in plan COO order, already scaled (plan.scaled_values)."""
+    axis, ndev = _resolve_axis(mesh, axis)
+    dsched = get_schedule(plan, ndev)
+    dtype = np.dtype(dtype)
+    thresh_np = _thresh_for(plan, dtype)
+
+    idx_args = _group_operands(dsched, range(5))
+    idx_specs = tuple(P(axis) for _ in idx_args)
+
+    def body(vals, *idx_flat):
+        per_group = _regroup(dsched, idx_flat, 5)
+        L, U, Li, Ui, tiny, nzero = _factor_loop(
+            dsched, vals, thresh_np, dtype, per_group, axis)
+        return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
+                jax.lax.psum(nzero, axis))
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(),) + idx_specs,
+        out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
+        check_vma=False)
+    jitted = jax.jit(lambda vals: mapped(vals, *idx_args))
+
+    def factor(vals) -> DistLU:
+        L, U, Li, Ui, tiny, nzero = jitted(vals)
+        if int(nzero) > 0:
+            raise ZeroDivisionError(
+                f"{int(nzero)} exactly-zero pivot(s); matrix singular")
+        return DistLU(plan=plan, mesh=mesh, axis=axis, dtype=dtype,
+                      schedule=dsched, L_flat=L, U_flat=U, Li_flat=Li,
+                      Ui_flat=Ui, tiny_pivots=int(tiny))
+
+    return factor
+
+
+def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
+                    axis=None, trans: bool = False):
+    """Build `solve(L, U, Li, Ui, b) -> x` against persistent sharded
+    factors.  b (n, nrhs) in factor ordering."""
+    axis, ndev = _resolve_axis(mesh, axis)
+    dsched = get_schedule(plan, ndev)
+    dtype = np.dtype(dtype)
+
+    idx_args = _group_operands(dsched, (5, 6))
+    idx_specs = tuple(P(axis) for _ in idx_args)
+
+    def body(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_flat):
+        per_group = _regroup(dsched, idx_flat, 2)
+        return _solve_loop(dsched, (L_flat, U_flat, Li_flat, Ui_flat),
+                           b, dtype, per_group, axis, trans=trans)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P()) + idx_specs,
+        out_specs=P(), check_vma=False)
+
+    @jax.jit
+    def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
+        return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
+
+    return solve
+
+
+def dist_solve(dlu: DistLU, b_factor_order, trans: bool = False):
+    """Solve against a DistLU.  Compiled solves are cached on the PLAN
+    keyed (mesh, dtype, trans), so SamePattern re-factorizations reuse
+    them across handles."""
+    plan = dlu.plan
+    cache = getattr(plan, "_dist_solve_fns", None)
+    if cache is None:
+        cache = plan._dist_solve_fns = {}
+    key = (dlu.mesh, dlu.dtype.str, dlu.axis, trans)
+    if key not in cache:
+        cache[key] = make_dist_solve(plan, dlu.mesh, dtype=dlu.dtype,
+                                     axis=dlu.axis, trans=trans)
+    return cache[key](dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                      dlu.Ui_flat, b_factor_order)

@@ -79,3 +79,38 @@ def test_grid_factory():
     assert g2.nprocs == 4
     with pytest.raises(ValueError):
         make_solver_mesh(4, 4, 4)
+
+
+def test_dist_backend_through_gssvx():
+    """backend='dist': sharded factors persist, refinement and the
+    FACTORED rung run over the mesh (the pdgssvx-on-a-grid contract)."""
+    from superlu_dist_tpu import Fact, Options, gssvx
+    from superlu_dist_tpu.parallel.factor_dist import DistLU
+
+    a = convection_diffusion_2d(9)
+    asp = a.to_scipy()
+    rng = np.random.default_rng(4)
+    xtrue = rng.standard_normal((a.n, 2))
+    b = asp @ xtrue
+    g = make_solver_mesh(2, 1, 2)
+    opts = Options(factor_dtype="float32")   # force refinement to work
+    x, lu, stats = gssvx(opts, a, b, grid=g)
+    assert isinstance(lu.device_lu, DistLU)
+    assert np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue) < 1e-10
+    assert stats.refine_steps >= 1
+    # FACTORED rung: reuse sharded factors for a new rhs
+    b2 = asp @ (xtrue + 1.0)
+    x2, _, _ = gssvx(Options(fact=Fact.FACTORED), a, b2, lu=lu, grid=g)
+    assert (np.linalg.norm(x2 - xtrue - 1.0)
+            / np.linalg.norm(xtrue + 1.0)) < 1e-10
+
+
+def test_dist_backend_trans():
+    from superlu_dist_tpu import Options, Trans, gssvx
+    a = convection_diffusion_2d(8)
+    asp = a.to_scipy()
+    xtrue = np.arange(1.0, a.n + 1.0)
+    b = asp.T @ xtrue
+    g = make_solver_mesh(1, 1, 4)
+    x, _, _ = gssvx(Options(trans=Trans.TRANS), a, b, grid=g)
+    assert np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue) < 1e-10

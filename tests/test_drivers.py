@@ -77,3 +77,33 @@ def test_get_diag_u_and_query_space(backend):
     qs = query_space(lu)
     assert qs["lu_nnz"] > a.nnz / 2
     assert qs["held_bytes"] >= qs["lu_bytes"] * 0.5
+
+
+def test_get_diag_u_dist_backend():
+    from superlu_dist_tpu import gssvx
+    from superlu_dist_tpu.parallel.grid import make_solver_mesh
+    a = laplacian_2d(8)
+    b = np.ones(a.n)
+    g = make_solver_mesh(2, 1, 2)
+    _, lu, _ = gssvx(Options(), a, b, grid=g)
+    d_dist = get_diag_u(lu)
+    lu_ref = factorize(a, Options(), backend="host")
+    d_ref = get_diag_u(lu_ref)
+    np.testing.assert_allclose(np.abs(d_dist), np.abs(d_ref),
+                               rtol=1e-10)
+
+
+def test_backend_grid_conflict_raises():
+    from superlu_dist_tpu import gssvx
+    from superlu_dist_tpu.parallel.grid import make_solver_mesh
+    a = laplacian_2d(5)
+    with pytest.raises(ValueError, match="conflicts"):
+        gssvx(Options(), a, np.ones(a.n), backend="jax",
+              grid=make_solver_mesh(2, 1, 1))
+
+
+def test_complex_matrix_real_dtype_promotes():
+    from superlu_dist_tpu.utils.testmat import helmholtz_2d
+    a = helmholtz_2d(5)
+    lu = factorize(a, Options(factor_dtype="float32"), backend="host")
+    assert np.dtype(lu.effective_options.factor_dtype) == np.complex64

@@ -124,3 +124,24 @@ def test_pddrive_fused_rejects_trans(tmp_path):
     write_binary(str(p), laplacian_2d(5))
     with pytest.raises(SystemExit):
         pddrive.main([str(p), "--fused", "--trans", "TRANS", "-q"])
+
+
+def test_batch_mode_vmap():
+    """Batch mode (EXAMPLE/pddrive batch analog): vmap the fused step
+    over independent same-pattern systems."""
+    import jax
+    a = laplacian_2d(6)
+    plan = plan_factorization(a, Options())
+    step = make_fused_solver(plan, dtype="float64", max_steps=2)
+    B = 3
+    rng = np.random.default_rng(7)
+    vals = np.stack([a.data * (1.0 + 0.1 * i) for i in range(B)])
+    xt = rng.standard_normal((B, a.n, 1))
+    sp = a.to_scipy()
+    bs = np.stack([(sp * (1.0 + 0.1 * i)) @ xt[i] for i in range(B)])
+    xb, berr, steps, tiny, nzero = jax.vmap(step)(
+        jnp.asarray(vals), jnp.asarray(bs))
+    for i in range(B):
+        relerr = (np.linalg.norm(np.asarray(xb)[i] - xt[i])
+                  / np.linalg.norm(xt[i]))
+        assert relerr < 1e-10, (i, relerr)
