@@ -70,13 +70,15 @@ def main(argv=None) -> int:
         print(f"matrix: {args.matrix}  n={n}  nnz={a.nnz}  "
               f"dtype={a.dtype}")
 
+    from ..models.gssvx import effective_factor_dtype
+
     complex_sys = np.issubdtype(a.dtype, np.complexfloating)
     fdt = args.dtype or ("complex128" if complex_sys else "float64")
-    if complex_sys and np.dtype(fdt).kind != "c":
-        # map the real mixed-precision request to its complex analog
-        fdt = np.promote_types(np.dtype(fdt), np.complex64).name
+    eff = effective_factor_dtype(a.dtype, fdt).name
+    if eff != fdt:
         if not args.quiet:
-            print(f"complex matrix: factor dtype mapped to {fdt}")
+            print(f"complex matrix: factor dtype mapped to {eff}")
+        fdt = eff
     opts = Options(
         factor_dtype=fdt,
         equil=not args.no_equil,

@@ -53,6 +53,17 @@ class LUFactorization:
         return self.options or self.plan.options
 
 
+def effective_factor_dtype(a_dtype, factor_dtype) -> np.dtype:
+    """A complex system forces a complex factor dtype of matching
+    precision (the reference's z drivers hard-code doublecomplex; a
+    silent cast would truncate imaginary parts)."""
+    fdt = np.dtype(factor_dtype)
+    if np.issubdtype(np.dtype(a_dtype), np.complexfloating) \
+            and fdt.kind != "c":
+        fdt = np.promote_types(fdt, np.complex64)
+    return fdt
+
+
 def factorize(a: CSRMatrix, options: Options | None = None,
               plan: FactorPlan | None = None,
               stats: Stats | None = None,
@@ -70,12 +81,8 @@ def factorize(a: CSRMatrix, options: Options | None = None,
                                   user_perm_r=user_perm_r,
                                   user_perm_c=user_perm_c)
     scaled = plan.scaled_values(a)
-    # a complex system forces a complex factor dtype of matching
-    # precision (the reference's z drivers hard-code doublecomplex; a
-    # silent cast would truncate imaginary parts)
-    fdt = np.dtype(options.factor_dtype)
-    if np.issubdtype(a.dtype, np.complexfloating) and fdt.kind != "c":
-        fdt = np.promote_types(fdt, np.complex64)
+    fdt = effective_factor_dtype(a.dtype, options.factor_dtype)
+    if fdt.name != options.factor_dtype:
         options = options.replace(factor_dtype=fdt.name)
     if backend == "auto":
         if grid is not None:
@@ -294,6 +301,20 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
                         Fact.SAME_PATTERN_SAME_ROWPERM) and lu is None:
         raise ValueError(f"options.fact={options.fact.name} requires "
                          "an existing lu")
+    if options.fact == Fact.FACTORED and lu is not None:
+        # a FACTORED reuse must be consistent with the stored factors:
+        # a grid request against a non-dist handle (or a different
+        # mesh) would silently be ignored otherwise
+        if grid is not None:
+            mesh = getattr(grid, "mesh", grid)
+            if lu.backend != "dist":
+                raise ValueError(
+                    "Fact.FACTORED with grid= requires factors from "
+                    f"the dist backend; this handle is {lu.backend!r}")
+            if lu.device_lu.mesh != mesh:
+                raise ValueError(
+                    "Fact.FACTORED grid mesh differs from the mesh "
+                    "the factors are sharded over")
     if options.fact == Fact.FACTORED:
         # honor the caller's SOLVE-time knobs on the reused handle;
         # factorization-describing knobs (factor_dtype, equil,

@@ -51,14 +51,23 @@ def _next_pow2(x: int) -> int:
     return 1 if x <= 1 else 1 << (x - 1).bit_length()
 
 
+def _next_bucket(x: int) -> int:
+    """Next length in the {2^k, 1.5·2^k} grid — ≤33% padding waste
+    while keeping the distinct-shape set logarithmic (the jit cache
+    key set for the unfused path; the fused program inlines every
+    group anyway, so finer quantization costs nothing there)."""
+    if x <= 1:
+        return 1
+    p = 1 << (x - 1).bit_length()      # next pow2 ≥ x
+    mid = p // 2 + p // 4              # 1.5·(p/2), the grid midpoint
+    return mid if x <= mid else p
+
+
 def _pad_idx(arr: np.ndarray, fill: int) -> np.ndarray:
-    """Pad an index array to the next power-of-FOUR length: coarser
-    quantization keeps the jit shape-key set small (compile count is
-    the dominant setup cost), at ≤4× scatter-index overhead."""
+    """Pad an index array to the next {2^k, 1.5·2^k} length (≤33%
+    scatter-index overhead; padded entries carry drop/zero indices)."""
     n = max(len(arr), 1)
-    target = 1
-    while target < n:
-        target *= 4
+    target = _next_bucket(n)
     out = np.full(target, fill, dtype=np.int64)
     out[:len(arr)] = arr
     return out
@@ -153,8 +162,8 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                                  []).append(int(s))
         for (wb, mb), slist in sorted(by_bucket.items()):
             N = len(slist)
-            # pad per-device count to a power of two (jit key bound)
-            n_loc = _next_pow2(-(-N // ndev))
+            # pad per-device count to the {2^k, 1.5·2^k} grid
+            n_loc = _next_bucket(-(-N // ndev))
             n_tot = n_loc * ndev
             rb = mb - wb
             f_loc = n_loc * mb * mb
@@ -728,7 +737,6 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         vals_r = vals.astype(rdt)
         abs_vals = jnp.abs(vals_r)
         b = b.astype(rdt)
-        x = _solve_once(flats, b)
 
         def resid_berr(xv):
             ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r,
@@ -740,13 +748,16 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return r, jnp.max(jnp.abs(r) / denom)
 
         if max_steps <= 0:
+            x = _solve_once(flats, b)
             _, berr = resid_berr(x)
             return x, berr, jnp.zeros((), jnp.int32), tiny, nzero
 
         eps = float(np.finfo(rdt.char.lower()
                              if rdt.kind == "c" else rdt).eps)
-        r0, berr0 = resid_berr(x)
 
+        # The sweeps are traced ONCE, inside the loop body: iteration 0
+        # IS the base solve (x=0, r=b), iterations 1.. are refinement —
+        # halves the compiled program vs solve-then-loop.
         def cond(state):
             _, _, berr, _, stop = state
             return jnp.logical_and(jnp.logical_not(stop), berr > eps)
@@ -756,19 +767,28 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             d = _solve_once(flats, r)
             x_new = x + d
             r_new, berr_new = resid_berr(x_new)
+            # the base solve (iteration 0) is kept unconditionally —
+            # the reference returns the unrefined solution even when
+            # refinement cannot improve it (non-finite berr included)
+            first = steps == 0
             improved = berr_new < berr * 0.5
-            better = berr_new < berr
+            better = jnp.logical_or(first, berr_new < berr)
             x = jnp.where(better, x_new, x)
             r = jnp.where(better, r_new, r)
             berr = jnp.where(better, berr_new, berr)
-            stop = jnp.logical_or(jnp.logical_not(improved),
-                                  steps + 1 >= max_steps)
+            stop = jnp.logical_or(
+                jnp.logical_and(jnp.logical_not(first),
+                                jnp.logical_not(improved)),
+                steps + 1 >= max_steps + 1)
             return x, r, berr, steps + 1, stop
 
+        x0 = jnp.zeros((n, b.shape[1]), rdt)
+        inf = jnp.asarray(np.inf, _real_dtype(rdt))
         x, _, berr, steps, _ = jax.lax.while_loop(
             cond, body,
-            (x, r0, berr0, jnp.zeros((), jnp.int32),
+            (x0, b, inf, jnp.zeros((), jnp.int32),
              jnp.zeros((), jnp.bool_)))
-        return x, berr, steps, tiny, nzero
+        # steps counts loop iterations; the first is the base solve
+        return x, berr, jnp.maximum(steps - 1, 0), tiny, nzero
 
     return jax.jit(step)

@@ -131,7 +131,7 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
 
 
 def _group_operands(dsched, fields):
-    """(specs, args) for the given GroupSpec.dev tuple positions."""
+    """Flat operand tuple for the given GroupSpec.dev positions."""
     group_idx = [g.dev(squeeze=False) for g in dsched.groups]
     args = tuple(t[i] for t in group_idx for i in fields)
     return args

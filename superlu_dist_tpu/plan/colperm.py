@@ -51,7 +51,18 @@ def get_perm_c(a: CSRMatrix, mode: ColPerm,
             raise ValueError("ColPerm.MY_PERMC requires user_perm_c")
         return np.asarray(user_perm_c, dtype=np.int64)
 
-    b = symmetrize_pattern(a)
+    if mode in (ColPerm.MMD_ATA, ColPerm.COLAMD):
+        # order the pattern of AᵀA (get_perm_c_dist's getata path;
+        # COLAMD approximates the same object without forming it — at
+        # our scales forming the boolean product is fine)
+        s = a.to_scipy()
+        pat = sp.csr_matrix(
+            (np.ones_like(s.data), s.indices, s.indptr), shape=s.shape)
+        b = (pat.T @ pat).tocsr()
+        b.sum_duplicates()
+        b.sort_indices()
+    else:
+        b = symmetrize_pattern(a)
     if mode == ColPerm.RCM:
         order = reverse_cuthill_mckee(b, symmetric_mode=True)
         perm_c = np.empty(n, dtype=np.int64)

@@ -107,3 +107,14 @@ def test_complex_matrix_real_dtype_promotes():
     a = helmholtz_2d(5)
     lu = factorize(a, Options(factor_dtype="float32"), backend="host")
     assert np.dtype(lu.effective_options.factor_dtype) == np.complex64
+
+
+def test_factored_grid_mismatch_raises():
+    from superlu_dist_tpu import Fact, gssvx
+    from superlu_dist_tpu.parallel.grid import make_solver_mesh
+    a = laplacian_2d(6)
+    b = np.ones(a.n)
+    lu = factorize(a, Options(), backend="host")
+    with pytest.raises(ValueError, match="dist backend"):
+        gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+              grid=make_solver_mesh(2, 1, 1))

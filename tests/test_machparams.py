@@ -1,0 +1,33 @@
+"""Machine-parameter sanity (INSTALL/dmachtst.c, smachtst.c,
+timertst.c analogs): eps/underflow/overflow behavior of every dtype the
+solver factors in, and timer monotonicity."""
+
+import time
+
+import numpy as np
+
+
+def test_machine_eps_contract():
+    for dt, eps_max in (("float32", 1e-6), ("float64", 1e-15),
+                        ("complex64", 1e-6), ("complex128", 1e-15)):
+        d = np.dtype(dt)
+        rd = np.dtype(d.char.lower()) if d.kind == "c" else d
+        eps = np.finfo(rd).eps
+        one = rd.type(1.0)
+        assert one + eps != one
+        assert one + eps / 2 == one
+        assert eps < eps_max
+
+
+def test_underflow_overflow_guards():
+    f = np.finfo(np.float64)
+    assert f.tiny > 0
+    assert np.isinf(f.max * 2)
+    # tiny-pivot threshold sqrt(eps)*anorm stays representable
+    assert np.sqrt(f.eps) * f.max / 2 < f.max
+
+
+def test_timer_monotone():
+    t0 = time.perf_counter()
+    time.sleep(0.01)
+    assert time.perf_counter() - t0 > 0.005
