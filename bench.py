@@ -29,7 +29,17 @@ import numpy as np
 def main():
     import scipy.sparse.linalg as spla
 
+    import jax
     import jax.numpy as jnp
+    try:
+        # persistent compilation cache: repeated bench runs (and the
+        # per-round driver invocation) skip the fused-program compile
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.ops.batched import make_fused_solver
     from superlu_dist_tpu.plan.plan import plan_factorization
@@ -71,17 +81,19 @@ def main():
         best = min(best, time.perf_counter() - t0)
     x = np.asarray(x)[:, 0]
     relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
-    assert relerr < 1e-9, f"accuracy check failed: {relerr}"
+    accuracy_ok = relerr < 1e-9
 
     gflops = plan.factor_flops / best / 1e9
     print(json.dumps({
         "metric": "fused sparse LU solve throughput "
                   f"(2D Laplacian n={k * k}, f32 factor + f64 device "
                   f"IR; relerr {relerr:.1e} vs scipy {ref_relerr:.1e}; "
-                  f"plan {t_plan:.2f}s warmup {t_warm:.1f}s)",
-        "value": round(gflops, 3),
+                  f"plan {t_plan:.2f}s warmup {t_warm:.1f}s"
+                  + ("" if accuracy_ok else "; ACCURACY CHECK FAILED")
+                  + ")",
+        "value": round(gflops, 3) if accuracy_ok else 0.0,
         "unit": "GFLOP/s",
-        "vs_baseline": round(t_scipy / best, 3),
+        "vs_baseline": round(t_scipy / best, 3) if accuracy_ok else 0.0,
     }))
     sys.stdout.flush()
 

@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-equil", action="store_true")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="echo the effective options "
+                        "(print_options_dist analog)")
     return p
 
 
@@ -87,6 +90,9 @@ def main(argv=None) -> int:
         iter_refine=IterRefine[args.refine],
         trans=Trans[args.trans],
     )
+
+    if args.verbose:
+        print(opts.describe())
 
     # manufactured solution (dGenXtrue_dist / dFillRHS_dist)
     rng = np.random.default_rng(args.seed)

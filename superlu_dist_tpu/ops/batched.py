@@ -261,11 +261,12 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
 
 
 def _thresh_for(plan: FactorPlan, dtype: np.dtype) -> float:
-    rdt = np.finfo(
-        np.dtype(dtype.char.lower()) if dtype.kind == "c" else dtype)
     if not plan.options.replace_tiny_pivot:
         return 0.0
-    return float(np.sqrt(rdt.eps) * plan.anorm)
+    rdt = np.dtype(dtype.char.lower()) if dtype.kind == "c" else dtype
+    # jnp.finfo also understands the ml_dtypes families (bfloat16)
+    eps = float(jnp.finfo(rdt).eps)
+    return float(np.sqrt(eps) * plan.anorm)
 
 
 def _real_dtype(dtype: np.dtype):
@@ -620,7 +621,8 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
 
 def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                       refine_dtype=None,
-                      max_steps: Optional[int] = None):
+                      max_steps: Optional[int] = None,
+                      mesh=None, axis=None):
     """Build `step(vals, b) -> (x, berr, steps, tiny, nzero)`: the
     ENTIRE pdgssvx numeric pipeline as ONE XLA program — scale +
     assemble + level-batched factorization in `dtype`, trisolve, then
@@ -632,12 +634,22 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     `vals` are the UNSCALED matrix values in plan COO order and `b` is
     the RHS in the ORIGINAL ordering, shape (n, nrhs) — scaling and
     permutation gathers happen in-program, so one dispatch serves the
-    SamePattern production loop."""
+    SamePattern production loop.
+
+    With `mesh` given the SAME program runs shard_map'd over the mesh:
+    fronts partition across devices, ancestor updates ride all_gather,
+    sweeps psum — multi-chip time-to-solution as one compiled step
+    (the pdgssvx3d-with-refinement contract)."""
     from .spmv import coo_spmv
 
     from ..options import IterRefine
 
-    sched = get_schedule(plan, 1)
+    if mesh is not None:
+        from ..parallel.factor_dist import _resolve_axis
+        axis, ndev = _resolve_axis(mesh, axis)
+    else:
+        axis, ndev = None, 1
+    sched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
     if refine_dtype is None:
         # honor the plan's refinement contract (models/refine.py):
@@ -680,7 +692,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         coo_cols=jnp.asarray(plan.coo_cols, dtype=idt),
     )
 
-    def _factor(scaled_vals):
+    def _factor(scaled_vals, per_group):
         thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
         svals = jnp.concatenate(
             [scaled_vals.astype(dtype), jnp.zeros(1, dtype)])
@@ -691,9 +703,8 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                  jnp.zeros(sched.Ui_total, dtype)]
         tiny = jnp.zeros((), jnp.int32)
         nzero = jnp.zeros((), jnp.int32)
-        for g in sched.groups:
-            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = \
-                g.dev(squeeze=True)
+        for g, idx in zip(sched.groups, per_group):
+            a_src, a_dst, one_dst, ea_src, ea_dst = idx[:5]
             (upd_buf, flats[0], flats[1], flats[2], flats[3], tiny,
              nzero) = _factor_group_impl(
                 svals, upd_buf, flats[0], flats[1], flats[2], flats[3],
@@ -701,39 +712,43 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                 ea_dst, jnp.int32(g.upd_off_global),
                 jnp.int32(g.L_off), jnp.int32(g.U_off),
                 jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
-                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
         return flats, tiny, nzero
 
-    def _sweep(flats, bf):
+    def _sweep(flats, bf, per_group):
         """Triangular solves in factor ordering, factor dtype."""
         L_flat, U_flat, Li_flat, Ui_flat = flats
         X = jnp.zeros((n + 1, bf.shape[1]), bf.dtype)
         X = X.at[:n, :].set(bf)
-        for g in sched.groups:
-            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-            X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
-                                struct_idx, jnp.int32(g.L_off),
+        for g, idx in zip(sched.groups, per_group):
+            X = _fwd_group_impl(X, L_flat, Li_flat, idx[5],
+                                idx[6], jnp.int32(g.L_off),
                                 jnp.int32(g.Li_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-        for g in reversed(sched.groups):
-            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-            X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
-                                struct_idx, jnp.int32(g.U_off),
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                axis=axis)
+        for g, idx in zip(reversed(sched.groups),
+                          reversed(per_group)):
+            X = _bwd_group_impl(X, U_flat, Ui_flat, idx[5],
+                                idx[6], jnp.int32(g.U_off),
                                 jnp.int32(g.Ui_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                axis=axis)
         return X[:n]
 
-    def _solve_once(flats, r):
+    def _solve_once(flats, r, per_group):
         """r (original order, rdt) -> correction (original order, rdt);
         sweeps run in factor precision like the reference's psgsrfs."""
         bf = (r * ops["row_scale"][:, None])[ops["inv_final_row"]]
-        y = _sweep(flats, bf.astype(dtype))
+        y = _sweep(flats, bf.astype(dtype), per_group)
         return (y[ops["final_col"]].astype(rdt)
                 * ops["col_scale"][:, None])
 
-    def step(vals, b):
+    def step_body(vals, b, per_group):
         scaled = vals * ops["scale_fac"]
-        flats, tiny, nzero = _factor(scaled)
+        flats, tiny, nzero = _factor(scaled, per_group)
+        if axis is not None:
+            tiny = jax.lax.psum(tiny, axis)
+            nzero = jax.lax.psum(nzero, axis)
         vals_r = vals.astype(rdt)
         abs_vals = jnp.abs(vals_r)
         b = b.astype(rdt)
@@ -748,7 +763,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return r, jnp.max(jnp.abs(r) / denom)
 
         if max_steps <= 0:
-            x = _solve_once(flats, b)
+            x = _solve_once(flats, b, per_group)
             _, berr = resid_berr(x)
             return x, berr, jnp.zeros((), jnp.int32), tiny, nzero
 
@@ -764,7 +779,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
 
         def body(state):
             x, r, berr, steps, _ = state
-            d = _solve_once(flats, r)
+            d = _solve_once(flats, r, per_group)
             x_new = x + d
             r_new, berr_new = resid_berr(x_new)
             # the base solve (iteration 0) is kept unconditionally —
@@ -791,4 +806,34 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         # steps counts loop iterations; the first is the base solve
         return x, berr, jnp.maximum(steps - 1, 0), tiny, nzero
 
-    return jax.jit(step)
+    if mesh is None:
+        per_group_const = [g.dev(squeeze=True) for g in sched.groups]
+
+        @jax.jit
+        def step(vals, b):
+            return step_body(vals, b, per_group_const)
+
+        return step
+
+    # mesh execution: group index arrays enter as sharded operands
+    from jax.sharding import PartitionSpec as P
+
+    idx_args = tuple(a for g in sched.groups
+                     for a in g.dev(squeeze=False))
+    idx_specs = tuple(P(axis) for _ in idx_args)
+
+    def mapped_body(vals, b, *idx_flat):
+        from ..parallel.factor_dist import _regroup
+        return step_body(vals, b, _regroup(sched, idx_flat, 7))
+
+    mapped = jax.shard_map(
+        mapped_body, mesh=mesh,
+        in_specs=(P(), P()) + idx_specs,
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def step(vals, b):
+        return mapped(vals, b, *idx_args)
+
+    return step

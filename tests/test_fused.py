@@ -145,3 +145,46 @@ def test_batch_mode_vmap():
         relerr = (np.linalg.norm(np.asarray(xb)[i] - xt[i])
                   / np.linalg.norm(xt[i]))
         assert relerr < 1e-10, (i, relerr)
+
+
+def test_bfloat16_factor_mode():
+    """bf16 factorization (the MXU-native dtype) + f64 refinement must
+    reach f64 accuracy — the aggressive end of the psgssvx_d2 ladder."""
+    from superlu_dist_tpu import Options, gssvx
+    a = laplacian_2d(10)
+    xtrue = np.ones(a.n)
+    b = a.to_scipy() @ xtrue
+    x, _, st = gssvx(Options(factor_dtype="bfloat16",
+                             max_refine_steps=20), a, b, backend="jax")
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-12, relerr
+    assert st.refine_steps >= 2   # bf16 genuinely needs the IR
+
+    plan = plan_factorization(a, Options(factor_dtype="bfloat16",
+                                         max_refine_steps=20))
+    step = make_fused_solver(plan, dtype="bfloat16")
+    xf, berr, steps, *_ = step(jnp.asarray(a.data),
+                               jnp.asarray(b[:, None]))
+    relerr = np.linalg.norm(np.asarray(xf)[:, 0] - xtrue) \
+        / np.linalg.norm(xtrue)
+    assert relerr < 1e-12, relerr
+
+
+def test_fused_solver_on_mesh():
+    """The fused factor+solve+refine step shard_map'd over a mesh must
+    match the single-device result (pdgssvx3d-with-refinement as one
+    program)."""
+    import jax
+    from jax.sharding import Mesh
+    a = convection_diffusion_2d(9)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    xtrue, b = manufactured_rhs(a, nrhs=2)
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, axis_names=("r", "c"))
+    step = make_fused_solver(plan, dtype="float32", mesh=mesh)
+    x, berr, steps, tiny, nzero = step(jnp.asarray(a.data),
+                                       jnp.asarray(b))
+    relerr = (np.linalg.norm(np.asarray(x) - xtrue)
+              / np.linalg.norm(xtrue))
+    assert relerr < 1e-10, relerr
+    assert float(berr) < 1e-13
