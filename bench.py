@@ -61,7 +61,7 @@ def main():
     # --- ours: fused f32 factor + f64 refine, ONE XLA program ---
     opts = Options(factor_dtype="float32")
     t0 = time.perf_counter()
-    plan = plan_factorization(a, opts)
+    plan = plan_factorization(a, opts, autotune=True)
     t_plan = time.perf_counter() - t0
     step = make_fused_solver(plan, dtype="float32")
     vals = jnp.asarray(a.data)
@@ -96,6 +96,10 @@ def main():
         "vs_baseline": round(t_scipy / best, 3) if accuracy_ok else 0.0,
     }))
     sys.stdout.flush()
+    if not accuracy_ok:
+        # the JSON line is printed either way, but an accuracy
+        # regression must still fail the process for exit-code gates
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -693,53 +693,22 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     )
 
     def _factor(scaled_vals, per_group):
-        thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
-        svals = jnp.concatenate(
-            [scaled_vals.astype(dtype), jnp.zeros(1, dtype)])
-        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
-        flats = [jnp.zeros(sched.L_total, dtype),
-                 jnp.zeros(sched.U_total, dtype),
-                 jnp.zeros(sched.Li_total, dtype),
-                 jnp.zeros(sched.Ui_total, dtype)]
-        tiny = jnp.zeros((), jnp.int32)
-        nzero = jnp.zeros((), jnp.int32)
-        for g, idx in zip(sched.groups, per_group):
-            a_src, a_dst, one_dst, ea_src, ea_dst = idx[:5]
-            (upd_buf, flats[0], flats[1], flats[2], flats[3], tiny,
-             nzero) = _factor_group_impl(
-                svals, upd_buf, flats[0], flats[1], flats[2], flats[3],
-                tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
-                ea_dst, jnp.int32(g.upd_off_global),
-                jnp.int32(g.L_off), jnp.int32(g.U_off),
-                jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
-                mb=g.mb, wb=g.wb, n_pad=g.n_loc, axis=axis)
-        return flats, tiny, nzero
-
-    def _sweep(flats, bf, per_group):
-        """Triangular solves in factor ordering, factor dtype."""
-        L_flat, U_flat, Li_flat, Ui_flat = flats
-        X = jnp.zeros((n + 1, bf.shape[1]), bf.dtype)
-        X = X.at[:n, :].set(bf)
-        for g, idx in zip(sched.groups, per_group):
-            X = _fwd_group_impl(X, L_flat, Li_flat, idx[5],
-                                idx[6], jnp.int32(g.L_off),
-                                jnp.int32(g.Li_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                axis=axis)
-        for g, idx in zip(reversed(sched.groups),
-                          reversed(per_group)):
-            X = _bwd_group_impl(X, U_flat, Ui_flat, idx[5],
-                                idx[6], jnp.int32(g.U_off),
-                                jnp.int32(g.Ui_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                axis=axis)
-        return X[:n]
+        # the group-loop drivers are factor_dist's — ONE implementation
+        # serves the fused solver, the split dist pair, and the dist
+        # step, so the paths cannot diverge
+        from ..parallel.factor_dist import _factor_loop
+        out = _factor_loop(sched, scaled_vals, thresh_np, dtype,
+                           per_group, axis)
+        return list(out[:4]), out[4], out[5]
 
     def _solve_once(flats, r, per_group):
         """r (original order, rdt) -> correction (original order, rdt);
         sweeps run in factor precision like the reference's psgsrfs."""
+        from ..parallel.factor_dist import _solve_loop
         bf = (r * ops["row_scale"][:, None])[ops["inv_final_row"]]
-        y = _sweep(flats, bf.astype(dtype), per_group)
+        solve_idx = [(t[5], t[6]) for t in per_group]
+        y = _solve_loop(sched, tuple(flats), bf.astype(dtype), dtype,
+                        solve_idx, axis, trans=False)
         return (y[ops["final_col"]].astype(rdt)
                 * ops["col_scale"][:, None])
 

@@ -1,0 +1,124 @@
+"""Bucket autotuning (the sp_ienv tunables analog, SURVEY.md §7 item 6).
+
+The padded-front execution quantizes supernode widths w and front sizes
+m = w + r onto bucket grids (Options.width_buckets/front_buckets).
+Coarse grids waste FLOPs/HBM on padding; fine grids multiply the number
+of (level, bucket) groups — program size and, off-TPU, compile time.
+This module picks grids from the ACTUAL (w, m) distribution of a
+pattern by weighted 1-D k-median dynamic programming: choose at most K
+bucket values minimizing total padded cost, where the cost of a front
+is the dense partial-LU flop model
+
+    cost(w', m') = w'²·m' + w'·(m'−w')²     (w', m' = bucketed sizes)
+
+Usage:
+    plan = plan_factorization(a, opts)
+    opts2 = autotuned_options(plan, opts)        # tightened grids
+    plan2 = plan_factorization(a, opts2)         # re-plan with them
+or one-shot: plan_factorization(a, opts, autotune=True).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _dp_buckets(values: np.ndarray, weights: np.ndarray,
+                max_buckets: int, cost_of) -> list:
+    """Choose ≤ max_buckets bucket boundaries from the unique sorted
+    `values` minimizing Σ weights·cost_of(bucket_value) where each
+    value maps to the smallest bucket ≥ it.  O(U²·K) DP — U is tiny
+    (distinct supernode sizes)."""
+    uniq = np.unique(values)
+    U = len(uniq)
+    if U == 0:
+        return []
+    K = min(max_buckets, U)
+    w_of = np.zeros(U)
+    for v, wt in zip(values, weights):
+        w_of[np.searchsorted(uniq, v)] += wt
+    # seg_cost[i][j]: cost of covering uniq[i..j] with bucket uniq[j]
+    seg = np.zeros((U, U))
+    for j in range(U):
+        c = cost_of(uniq[j])
+        for i in range(j + 1):
+            seg[i, j] = np.dot(w_of[i:j + 1], np.full(j - i + 1, c))
+    INF = np.inf
+    dp = np.full((K + 1, U), INF)
+    choice = np.zeros((K + 1, U), dtype=np.int64)
+    for j in range(U):
+        dp[1, j] = seg[0, j]
+    for k in range(2, K + 1):
+        for j in range(k - 1, U):
+            best, arg = INF, -1
+            for i in range(k - 2, j):
+                c = dp[k - 1, i] + seg[i + 1, j]
+                if c < best:
+                    best, arg = c, i
+            dp[k, j], choice[k, j] = best, arg
+    # fewer buckets may tie; pick minimal k within 1% of the best cost
+    best_k = min(range(1, K + 1), key=lambda k: dp[k, U - 1])
+    for k in range(1, best_k):
+        if dp[k, U - 1] <= dp[best_k, U - 1] * 1.01:
+            best_k = k
+            break
+    # backtrack
+    out = []
+    j = U - 1
+    k = best_k
+    while k >= 1:
+        out.append(int(uniq[j]))
+        if k == 1:
+            break
+        j = int(choice[k, j])
+        k -= 1
+    return sorted(out)
+
+
+def autotuned_options(plan, options=None, max_width_buckets: int = 10,
+                      max_front_buckets: int = 16):
+    """Return options with width/front bucket grids fit to this plan's
+    supernode population (pattern-keyed, so cacheable alongside the
+    plan — the SamePattern rung)."""
+    options = options or plan.options
+    fp = plan.frontal
+    w = np.asarray([int(x) for x in fp.w])
+    m = np.asarray([int(x) for x in fp.m])
+
+    # weight each supernode by its flop share so the DP optimizes where
+    # the work is
+    flops = w * w * m + w * (m - w) ** 2 + 1.0
+    wb = _dp_buckets(w, flops, max_width_buckets,
+                     cost_of=lambda wv: float(wv))
+
+    # legalize widths first: the blocked LU kernel needs wb ≤ 32 or
+    # wb ≡ 0 mod 32 (dense_lu.partial_lu block size), and TPU tiles
+    # like multiples of 8
+    def legal_w(v):
+        if v > 32:
+            return -(-v // 32) * 32
+        return -(-v // 8) * 8 if v > 8 else v
+    wb = sorted({legal_w(int(v)) for v in wb})
+
+    # front buckets are fit to the sizes the frontal plan will ACTUALLY
+    # bucketize — max(width_bucket(w) + r, m) — not to the raw m, so
+    # width legalization cannot push fronts past every chosen bucket
+    wb_arr = np.asarray(wb)
+    wb_of = wb_arr[np.searchsorted(wb_arr, w)]
+    m_eff = np.maximum(wb_of + (m - w), m)
+    mb = _dp_buckets(m_eff, flops, max_front_buckets,
+                     cost_of=lambda mv: float(mv) ** 2)
+    mb = sorted({-(-int(v) // 8) * 8 for v in mb})
+    return options.replace(width_buckets=tuple(wb),
+                           front_buckets=tuple(mb))
+
+
+def padded_flops(plan) -> float:
+    """Total padded partial-LU flops of the plan's schedule shapes —
+    the quantity autotuning minimizes; exposed for reporting."""
+    fp = plan.frontal
+    total = 0.0
+    for s in range(fp.nsuper):
+        wb, mb = int(fp.wb[s]), int(fp.mb[s])
+        total += wb * wb * mb + wb * (mb - wb) ** 2
+    return total

@@ -72,8 +72,12 @@ class FactorPlan:
 def plan_factorization(a: CSRMatrix, options: Options | None = None,
                        stats: Stats | None = None,
                        user_perm_r: np.ndarray | None = None,
-                       user_perm_c: np.ndarray | None = None) -> FactorPlan:
-    """Run the full preprocessing pipeline on the host."""
+                       user_perm_c: np.ndarray | None = None,
+                       autotune: bool = False) -> FactorPlan:
+    """Run the full preprocessing pipeline on the host.  With
+    `autotune`, the padding bucket grids are refit to this pattern's
+    supernode population (plan/autotune.py) and the frontal maps
+    rebuilt — a once-per-pattern cost, like the rest of the plan."""
     options = options or Options()
     stats = stats if stats is not None else Stats()
     if a.m != a.n:
@@ -161,5 +165,12 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         final_row=final_row, final_col=final_col,
         coo_rows=coo_rows, coo_cols=coo_cols,
         frontal=frontal, anorm=anorm)
+    if autotune:
+        from .autotune import autotuned_options
+        tuned = autotuned_options(plan, options)
+        with stats.timer("DIST"):
+            plan.frontal = build_frontal_plan(
+                sym, fr, fc, tuned.width_buckets, tuned.front_buckets)
+        plan.options = tuned
     stats.lu_nnz = plan.lu_nnz()
     return plan

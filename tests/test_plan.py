@@ -131,3 +131,29 @@ def test_nd_order_reduces_fill_vs_natural():
                        relax=8, max_super=64))
         nnz[cp] = plan.lu_nnz()
     assert nnz[ColPerm.METIS_AT_PLUS_A] < nnz[ColPerm.NATURAL]
+
+
+def test_autotuned_buckets_reduce_padding():
+    """Autotuned bucket grids must stay correct and not increase
+    padded flops (plan/autotune.py DP)."""
+    import numpy as np
+    from superlu_dist_tpu import Options, gssvx
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.plan.autotune import padded_flops
+    from superlu_dist_tpu.utils.testmat import (convection_diffusion_2d,
+                                                manufactured_rhs)
+
+    a = convection_diffusion_2d(12)
+    p0 = plan_factorization(a, Options())
+    p1 = plan_factorization(a, Options(), autotune=True)
+    assert padded_flops(p1) <= padded_flops(p0) * 1.001
+    # legalized width buckets: ≤32 or multiples of 32
+    for w in p1.options.width_buckets:
+        assert w <= 32 or w % 32 == 0
+    xtrue, b = manufactured_rhs(a)
+    for plan in (p0, p1):
+        from superlu_dist_tpu import factorize, solve
+        lu = factorize(a, plan=plan, backend="jax")
+        x = solve(lu, b)
+        relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+        assert relerr < 1e-10
