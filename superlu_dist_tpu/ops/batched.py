@@ -212,24 +212,33 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 t = np.arange(wb)
                 per_dev["one"][d].append(b * mb * mb + t * mb + t)
 
-            def stack(key, fill):
+            def stack(key, fill, distinct_pad=False):
+                """distinct_pad gives every padding slot its own
+                out-of-bounds destination (f_loc + i): the scatter can
+                then be promised unique_indices (a parallel lowering on
+                TPU) without the repeated-fill duplicates breaking the
+                promise."""
                 cat = [np.concatenate(v) if v else
                        np.empty(0, dtype=np.int64)
                        for v in per_dev[key]]
                 maxlen = max(len(c) for c in cat)
-                padded = [
-                    _pad_idx(np.concatenate(
+                padded = []
+                for c in cat:
+                    p = _pad_idx(np.concatenate(
                         [c, np.full(maxlen - len(c), fill,
                                     dtype=np.int64)]), fill)
-                    for c in cat]
+                    if distinct_pad:
+                        bad = np.flatnonzero(p == fill)
+                        p[bad] = fill + np.arange(len(bad))
+                    padded.append(p)
                 return np.stack(padded)
 
             groups.append(GroupSpec(
                 level=lv, mb=mb, wb=wb, n_loc=n_loc, n_true=N,
                 sup_ids=np.asarray(slist, dtype=np.int64),
                 a_src=stack("a_src", nnz),
-                a_dst=stack("a_dst", f_loc),     # OOB -> dropped
-                one_dst=stack("one", f_loc),
+                a_dst=stack("a_dst", f_loc, distinct_pad=True),
+                one_dst=stack("one", f_loc, distinct_pad=True),
                 ea_src=stack("ea_src", -1),      # finalized below
                 ea_dst=stack("ea_dst", f_loc),
                 col_idx=col_idx, struct_idx=struct_idx,
@@ -289,8 +298,11 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     F = jnp.zeros(n_pad * mb * mb, dtype)
-    F = F.at[a_dst].add(vals[a_src], mode="drop")
-    F = F.at[one_dst].set(one, mode="drop")
+    # a_dst/one_dst carry DISTINCT out-of-bounds padding, so the
+    # unique-indices promise holds and the scatters lower parallel
+    F = F.at[a_dst].add(vals[a_src], mode="drop",
+                        unique_indices=True)
+    F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
     F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
     F = F.reshape(n_pad, mb, mb)
 
@@ -325,11 +337,6 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
             tiny + tiny_g, nzero + nzero_g)
 
 
-_factor_group = functools.partial(
-    jax.jit,
-    static_argnames=("mb", "wb", "n_pad", "axis"),
-    donate_argnames=("upd_buf", "L_flat", "U_flat", "Li_flat",
-                     "Ui_flat"))(_factor_group_impl)
 
 
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
@@ -356,9 +363,6 @@ def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
     return X + jax.lax.psum(delta, axis)
 
 
-_fwd_group = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
-    donate_argnames=("X",))(_fwd_group_impl)
 
 
 def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
@@ -381,9 +385,6 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
     return X + jax.lax.psum(delta, axis)
 
 
-_bwd_group = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
-    donate_argnames=("X",))(_bwd_group_impl)
 
 
 # transpose sweeps: Mᵀ = Uᵀ·Lᵀ — forward on lower-triangular Uᵀ,
@@ -413,9 +414,6 @@ def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
     return X + jax.lax.psum(delta, axis)
 
 
-_fwd_group_T = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
-    donate_argnames=("X",))(_fwd_group_T_impl)
 
 
 def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
@@ -438,9 +436,6 @@ def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
     return X + jax.lax.psum(delta, axis)
 
 
-_bwd_group_T = functools.partial(
-    jax.jit, static_argnames=("mb", "wb", "n_pad", "axis"),
-    donate_argnames=("X",))(_bwd_group_T_impl)
 
 
 # --------------------------------------------------------------------
@@ -461,32 +456,44 @@ class DeviceLU:
     tiny_pivots: int
 
 
+def _phase_fns(sched, dtype, thresh_np):
+    """Cached whole-phase jitted programs for a (schedule, dtype):
+    factor, solve and transpose-solve each compile ONCE and run as a
+    single dispatch (vs one dispatch per group).  Backed by
+    factor_dist's shared _factor_loop/_solve_loop so every execution
+    mode runs the same group-loop code."""
+    cache = getattr(sched, "_phase_fns", None)
+    if cache is None:
+        cache = sched._phase_fns = {}
+    key = (np.dtype(dtype).str, float(thresh_np))
+    if key in cache:
+        return cache[key]
+    from ..parallel.factor_dist import _factor_loop, _solve_loop
+    per_group = [g.dev(squeeze=True) for g in sched.groups]
+    pairs = [(t[5], t[6]) for t in per_group]
+    dtype = np.dtype(dtype)
+
+    @jax.jit
+    def factor_fn(vals):
+        return _factor_loop(sched, vals, thresh_np, dtype, per_group,
+                            None)
+
+    @functools.partial(jax.jit, static_argnames=("trans",))
+    def solve_fn(L, U, Li, Ui, b, trans=False):
+        return _solve_loop(sched, (L, U, Li, Ui), b, dtype, pairs,
+                           None, trans=trans)
+
+    cache[key] = (factor_fn, solve_fn)
+    return cache[key]
+
+
 def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
                      dtype=np.float64) -> DeviceLU:
     sched = get_schedule(plan, 1)
     dtype = np.dtype(dtype)
-    thresh = jnp.asarray(_thresh_for(plan, dtype),
-                         dtype=_real_dtype(dtype))
-
-    vals = jnp.asarray(
-        np.concatenate([scaled_vals.astype(dtype), np.zeros(1, dtype)]))
-    upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
-    L_flat = jnp.zeros(sched.L_total, dtype)
-    U_flat = jnp.zeros(sched.U_total, dtype)
-    Li_flat = jnp.zeros(sched.Li_total, dtype)
-    Ui_flat = jnp.zeros(sched.Ui_total, dtype)
-    tiny = jnp.zeros((), jnp.int32)
-    nzero = jnp.zeros((), jnp.int32)
-
-    for g in sched.groups:
-        a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = g.dev(squeeze=True)
-        (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
-         nzero) = _factor_group(
-            vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
-            nzero, thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
-            jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
-            jnp.int32(g.U_off), jnp.int32(g.Li_off),
-            jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+    factor_fn, _ = _phase_fns(sched, dtype, _thresh_for(plan, dtype))
+    (L_flat, U_flat, Li_flat, Ui_flat, tiny,
+     nzero) = factor_fn(jnp.asarray(scaled_vals.astype(dtype)))
 
     if int(nzero) > 0:
         # reference semantics: U(i,i) == 0 with ReplaceTinyPivot=NO is
@@ -502,55 +509,29 @@ def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
                     tiny_pivots=int(tiny))
 
 
-def solve_device(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
-    """b in factor ordering, (n,) or (n, nrhs); returns same shape."""
-    sched = lu.schedule
+def _solve_device_common(lu: DeviceLU, b: np.ndarray, trans: bool):
     squeeze = b.ndim == 1
     bb = b[:, None] if squeeze else b
+    _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
+                             _thresh_for(lu.plan, lu.dtype))
     # promote rather than cast: a complex rhs against a real factor
     # must stay complex (matmuls promote; matches the host backend)
     xdt = np.promote_types(lu.dtype, bb.dtype)
-    X = jnp.zeros((sched.n + 1, bb.shape[1]), xdt)
-    X = X.at[:sched.n, :].set(jnp.asarray(bb.astype(xdt)))
-
-    for g in sched.groups:
-        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-        X = _fwd_group(X, lu.L_flat, lu.Li_flat, col_idx, struct_idx,
-                       jnp.int32(g.L_off), jnp.int32(g.Li_off),
-                       mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-    for g in reversed(sched.groups):
-        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-        X = _bwd_group(X, lu.U_flat, lu.Ui_flat, col_idx, struct_idx,
-                       jnp.int32(g.U_off), jnp.int32(g.Ui_off),
-                       mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-
-    out = np.asarray(X[:sched.n])
+    X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
+                 jnp.asarray(bb.astype(xdt)), trans=trans)
+    out = np.asarray(X)
     return out[:, 0] if squeeze else out
+
+
+def solve_device(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
+    """b in factor ordering, (n,) or (n, nrhs); returns same shape."""
+    return _solve_device_common(lu, b, trans=False)
 
 
 def solve_device_trans(lu: DeviceLU, b: np.ndarray) -> np.ndarray:
     """Solve Mᵀ·x = b (factor ordering): forward with Uᵀ, backward
     with Lᵀ over the same group schedule."""
-    sched = lu.schedule
-    squeeze = b.ndim == 1
-    bb = b[:, None] if squeeze else b
-    xdt = np.promote_types(lu.dtype, bb.dtype)
-    X = jnp.zeros((sched.n + 1, bb.shape[1]), xdt)
-    X = X.at[:sched.n, :].set(jnp.asarray(bb.astype(xdt)))
-
-    for g in sched.groups:
-        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-        X = _fwd_group_T(X, lu.U_flat, lu.Ui_flat, col_idx, struct_idx,
-                         jnp.int32(g.U_off), jnp.int32(g.Ui_off),
-                         mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-    for g in reversed(sched.groups):
-        _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
-        X = _bwd_group_T(X, lu.L_flat, lu.Li_flat, col_idx, struct_idx,
-                         jnp.int32(g.L_off), jnp.int32(g.Li_off),
-                         mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-
-    out = np.asarray(X[:sched.n])
-    return out[:, 0] if squeeze else out
+    return _solve_device_common(lu, b, trans=True)
 
 
 # --------------------------------------------------------------------
