@@ -290,6 +290,24 @@ def _real_dtype(dtype: np.dtype):
 # the oracle and the distributed path cannot diverge.
 # --------------------------------------------------------------------
 
+def _hi_prec(fn):
+    """Trace `fn` under full-f32 matmul precision.
+
+    TPU MXU matmuls on float32 inputs default to single-pass bfloat16
+    (~8e-3 relative error), which destroys the f32 factor as an
+    iterative-refinement preconditioner: convergence needs
+    cond(A)·eps_factor < 1 (SRC/psgssvx_d2.c strategy).  CPU ignores
+    the setting, f64 is unaffected, so this pins TPU semantics to what
+    the numerics require.  Measured on-chip: the 6-pass f32 mode is not
+    slower than 3-pass for this workload (it is latency-, not
+    MXU-bound), so use full float32."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("float32"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        tiny, nzero, thresh, a_src, a_dst, one_dst,
                        ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
@@ -552,6 +570,7 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
+    @_hi_prec
     def step(vals, b):
         thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
         vals = jnp.concatenate(

@@ -47,8 +47,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..plan.plan import FactorPlan
 from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl,
                            _factor_group_impl, _fwd_group_impl,
-                           _fwd_group_T_impl, _real_dtype, _thresh_for,
-                           get_schedule)
+                           _fwd_group_T_impl, _hi_prec, _real_dtype,
+                           _thresh_for, get_schedule)
 
 
 def _resolve_axis(mesh: Mesh, axis):
@@ -70,6 +70,7 @@ def _regroup(dsched, idx_flat, per):
             for _ in dsched.groups]
 
 
+@_hi_prec
 def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
     """Shared factorization group loop (runs inside shard_map)."""
     thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
@@ -94,6 +95,7 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
 
 
+@_hi_prec
 def _solve_loop(dsched, flats, b, dtype, per_group, axis,
                 trans: bool):
     """Shared triangular-sweep loop (runs inside shard_map).
