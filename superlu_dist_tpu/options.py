@@ -107,6 +107,18 @@ class Options:
     # look-ahead window depth (num_lookaheads=10 in the reference; on TPU
     # this controls cross-level pipelining of panel collectives)
     num_lookaheads: int = 10
+    # supernode amalgamation (plan/symbolic.py amalgamate): merge
+    # contiguous parent/child supernodes while total true flops grow at
+    # most (1+amalg_tau)×; fewer, bigger fronts trade cheap MXU flops
+    # for fewer sequential level steps.  0 disables.  The reference has
+    # no analog (it relaxes only at the leaves) — this knob exists
+    # because the latency/flop trade is inverted on TPU.
+    amalg_tau: float = dataclasses.field(
+        default_factory=lambda: float(_env_int("SUPERLU_AMALG_TAU_PCT",
+                                               100)) / 100.0)
+    # width cap for amalgamated supernodes (MAX_SUPER_SIZE analog)
+    amalg_cap: int = dataclasses.field(
+        default_factory=lambda: _env_int("SUPERLU_AMALG_CAP", 512))
 
     # --- precision strategy (the psgssvx_d2 mixed mode, SRC/psgssvx_d2.c:516,
     # generalized: factor in `factor_dtype`, accumulate residuals in

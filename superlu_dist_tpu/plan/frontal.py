@@ -33,6 +33,16 @@ import numpy as np
 from .symbolic import SymbolicFactorization
 
 
+def front_flops(w, r):
+    """True flops to factor a front of pivot width w with r off-block
+    rows: partial LU (2/3 w³) + two TRSMs (w²r each) + GEMM (2wr²).
+    Vectorized; the single cost model shared by factor_flops and the
+    amalgamation merge bound (plan/symbolic.py amalgamate)."""
+    wf = np.asarray(w, dtype=np.float64)
+    rf = np.asarray(r, dtype=np.float64)
+    return 2.0 / 3.0 * wf**3 + 2.0 * wf * wf * rf + 2.0 * wf * rf * rf
+
+
 def bucketize(values: np.ndarray, buckets: tuple) -> np.ndarray:
     """Smallest bucket ≥ value.  The bucket ladder is extended
     geometrically (×1.5, rounded up to 256) past its configured top so
@@ -142,11 +152,7 @@ def build_frontal_plan(sym: SymbolicFactorization,
     nlev = int(part.levels.max()) + 1 if ns else 0
     level_supernodes = [np.where(part.levels == lv)[0] for lv in range(nlev)]
 
-    # true flops: partial LU (2/3 w³) + two TRSMs (w²r each) + GEMM (2wr²)
-    wf = w.astype(np.float64)
-    rf = r.astype(np.float64)
-    factor_flops = float(np.sum(2.0 / 3.0 * wf**3 + 2.0 * wf * wf * rf
-                                + 2.0 * wf * rf * rf))
+    factor_flops = float(np.sum(front_flops(w, r)))
 
     return FrontalPlan(sym=sym, n=n, w=w, r=r, m=m, wb=wb, mb=mb, I=I,
                        a_src=a_src, a_lr=a_lr, a_lc=a_lc, ea_map=ea_map,

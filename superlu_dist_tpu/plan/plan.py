@@ -26,7 +26,7 @@ from .etree import (col_counts_postordered, etree_symmetric, postorder,
                     relabel_tree)
 from .frontal import FrontalPlan, build_frontal_plan
 from .supernodes import find_supernodes
-from .symbolic import symbolic_factorize
+from .symbolic import amalgamate, symbolic_factorize
 
 
 @dataclasses.dataclass
@@ -148,6 +148,7 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         part = find_supernodes(parent, colcount,
                                options.relax, options.max_super)
         sym = symbolic_factorize(b_indptr, b_indices, part)
+        sym = amalgamate(sym, options.amalg_tau, options.amalg_cap)
 
     # [Dist-plan] frontal maps (the pddistribute analog — here it
     # produces static index maps instead of MPI send lists)

@@ -77,6 +77,78 @@ def _child_lists(part: SupernodePartition) -> List[np.ndarray]:
     return [np.asarray(c, dtype=np.int64) for c in children]
 
 
+def amalgamate(sym: SymbolicFactorization, tau: float,
+               cap: int) -> SymbolicFactorization:
+    """Supernode amalgamation: merge a supernode into its parent when
+    the parent is the immediately-following supernode (column
+    contiguity) and the true-flop growth stays within `tau`.
+
+    The reference only relaxes at the leaves (relax_snode,
+    SRC/sp_ienv.c sp_ienv(2)); on TPU the trade is much more
+    favorable — every merge removes a whole sequential level-batch
+    step (group dispatch + its per-column panel loop) at the cost of
+    explicit zeros that the MXU churns through for free — so merging
+    is applied over the whole tree, CHOLMOD-style.
+
+    Correctness: merging rightmost child s into parent p keeps the
+    multifrontal invariants — merged columns are contiguous, merged
+    struct is struct(p) (since struct(s) ⊆ cols(p) ∪ struct(p)), and
+    grandchild extend-adds still land inside the merged front.
+
+    The growth bound is GLOBAL: each group tracks the sum of its
+    members' original front flops, and a merge must keep the merged
+    front within (1+tau)× that sum, so total factorization flops grow
+    at most (1+tau)× overall."""
+    part = sym.part
+    ns = part.nsuper
+    if ns <= 1 or tau <= 0:
+        return sym
+    from .etree import tree_levels_from_leaves
+    # deferred import: frontal.py imports SymbolicFactorization from
+    # this module at top level
+    from .frontal import front_flops as f
+
+    xsup = part.xsup
+    w = np.diff(xsup).astype(np.int64)
+    r = np.array([len(t) for t in sym.struct], dtype=np.int64)
+    sparent = part.sparent
+
+    gw = w.copy()                    # accumulated group width (top = s)
+    forig = f(w, r)
+    absorb = np.zeros(ns, dtype=bool)   # absorb[s]: s merged into s+1
+    for s in range(ns - 1):
+        if sparent[s] != s + 1:
+            continue
+        W = gw[s] + w[s + 1]
+        if W > cap:
+            continue
+        fo = forig[s] + f(w[s + 1], r[s + 1])
+        if f(W, r[s + 1]) <= (1.0 + tau) * fo:
+            absorb[s] = True
+            gw[s + 1] += gw[s]
+            forig[s + 1] += forig[s]
+
+    if not absorb.any():
+        return sym
+    tops = np.flatnonzero(~absorb)
+    new_ns = len(tops)
+    new_xsup = np.concatenate([[0], xsup[tops + 1]]).astype(np.int64)
+    new_supno = np.repeat(np.arange(new_ns, dtype=np.int64),
+                          np.diff(new_xsup))
+    group_of = np.searchsorted(tops, np.arange(ns))  # orig sup -> group
+    new_sparent = np.full(new_ns, -1, dtype=np.int64)
+    for k, t in enumerate(tops):
+        p = sparent[t]
+        new_sparent[k] = -1 if p == -1 else group_of[p]
+    new_part = SupernodePartition(
+        new_ns, new_xsup, new_supno, new_sparent,
+        tree_levels_from_leaves(new_sparent))
+    return SymbolicFactorization(
+        part=new_part,
+        struct=[sym.struct[t] for t in tops],
+        children=_child_lists(new_part))
+
+
 def symbolic_factorize_py(b_indptr: np.ndarray, b_indices: np.ndarray,
                           part: SupernodePartition) -> SymbolicFactorization:
     """Pure-Python fallback / test oracle for symbolic_factorize."""
