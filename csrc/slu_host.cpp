@@ -27,6 +27,7 @@
 #include <cstring>
 #include <limits>
 #include <queue>
+#include <thread>
 #include <vector>
 
 using std::int64_t;
@@ -428,17 +429,63 @@ struct SymbHandle {
   int64_t total = 0;
 };
 
+void* slu_symbfact_create_par(int64_t n, const int64_t* b_indptr,
+                              const int64_t* b_indices, int64_t nsuper,
+                              const int64_t* xsup,
+                              const int64_t* sparent, int64_t nthreads);
+
 void* slu_symbfact_create(int64_t n, const int64_t* b_indptr,
                           const int64_t* b_indices, int64_t nsuper,
                           const int64_t* xsup, const int64_t* sparent) {
+  // one union-pass implementation: the parallel variant with one
+  // worker IS the serial pass (every level takes the serial branch)
+  return slu_symbfact_create_par(n, b_indptr, b_indices, nsuper, xsup,
+                                 sparent, 1);
+}
+
+// Parallel supernodal symbolic factorization: level-synchronous over
+// the supernodal etree — all supernodes at one level depend only on
+// children at lower levels, so each level is an embarrassingly
+// parallel batch.  This is the shared-memory analog of the
+// reference's parallel symbfact_dist (SRC/psymbfact.c:150: its
+// domain_symbfact phase = the low, wide levels here; its
+// interLvl/intraLvl phases = the narrow top levels, which this
+// version simply runs on one thread since they hold a tiny fraction
+// of the work).  Output is bit-identical to slu_symbfact_create.
+void* slu_symbfact_create_par(int64_t n, const int64_t* b_indptr,
+                              const int64_t* b_indices, int64_t nsuper,
+                              const int64_t* xsup,
+                              const int64_t* sparent,
+                              int64_t nthreads) {
   auto* h = new SymbHandle();
   h->structs.resize(nsuper);
   std::vector<std::vector<int64_t>> children(nsuper);
-  for (int64_t s = 0; s < nsuper; ++s)
-    if (sparent[s] != -1) children[sparent[s]].push_back(s);
-  std::vector<int64_t> mark(n, -1);
-  std::vector<int64_t> rows;
-  for (int64_t s = 0; s < nsuper; ++s) {
+  std::vector<int64_t> level(nsuper, 0);
+  int64_t maxlev = 0;
+  for (int64_t s = 0; s < nsuper; ++s) {  // postorder: s < sparent[s]
+    int64_t p = sparent[s];
+    if (p != -1) {
+      children[p].push_back(s);
+      if (level[s] + 1 > level[p]) level[p] = level[s] + 1;
+    }
+    if (level[s] > maxlev) maxlev = level[s];
+  }
+  std::vector<std::vector<int64_t>> bylevel(maxlev + 1);
+  for (int64_t s = 0; s < nsuper; ++s) bylevel[level[s]].push_back(s);
+
+  int64_t nt = std::max<int64_t>(
+      1, std::min<int64_t>(nthreads, 16));
+  // per-thread mark scratch, grown lazily to the widest parallel
+  // level's worker count; mark values are supernode ids, unique
+  // across the whole run, so scratch is reusable across levels
+  std::vector<std::vector<int64_t>> marks;
+  auto ensure_marks = [&](int64_t use) {
+    while ((int64_t)marks.size() < use)
+      marks.emplace_back(n, -1);
+  };
+
+  auto do_sup = [&](int64_t s, std::vector<int64_t>& mark,
+                    std::vector<int64_t>& rows) {
     int64_t last = xsup[s + 1] - 1;
     rows.clear();
     for (int64_t j = xsup[s]; j <= last; ++j)
@@ -451,8 +498,29 @@ void* slu_symbfact_create(int64_t n, const int64_t* b_indptr,
         if (i > last && mark[i] != s) { mark[i] = s; rows.push_back(i); }
     std::sort(rows.begin(), rows.end());
     h->structs[s] = rows;
-    h->total += (int64_t)rows.size();
+  };
+
+  for (auto& sups : bylevel) {
+    int64_t cnt = (int64_t)sups.size();
+    int64_t use = std::min(nt, cnt);
+    if (use <= 1 || cnt < 64) {
+      ensure_marks(1);
+      std::vector<int64_t> rows;
+      for (int64_t s : sups) do_sup(s, marks[0], rows);
+    } else {
+      ensure_marks(use);
+      std::vector<std::thread> pool;
+      pool.reserve((size_t)use);
+      for (int64_t t = 0; t < use; ++t)
+        pool.emplace_back([&, t]() {
+          std::vector<int64_t> rows;
+          for (int64_t i = t; i < cnt; i += use)
+            do_sup(sups[i], marks[t], rows);
+        });
+      for (auto& th : pool) th.join();
+    }
   }
+  for (auto& v : h->structs) h->total += (int64_t)v.size();
   return h;
 }
 
@@ -479,6 +547,6 @@ void slu_symbfact_free(void* handle) {
   delete static_cast<SymbHandle*>(handle);
 }
 
-int64_t slu_version() { return 1; }
+int64_t slu_version() { return 2; }
 
 }  // extern "C"

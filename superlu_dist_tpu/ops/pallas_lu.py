@@ -48,6 +48,101 @@ def enabled(dtype) -> bool:
     return flag == "1"
 
 
+def _pick_nb(wb: int, nb_max: int = 32) -> int:
+    """Largest panel block ≤ nb_max dividing wb (wb buckets live on
+    the {2^k, 1.5·2^k} grid, so a divisor ≤ 32 always exists)."""
+    if wb <= nb_max:
+        return wb
+    for d in (32, 24, 16, 12, 8, 4, 2, 1):
+        if d <= nb_max and wb % d == 0:
+            return d
+    return 1
+
+
+def _unit_lower_inverse_newton(L, nb: int):
+    """inv(unit-lower L) via Newton iteration X ← X(2I − LX), exact
+    after ⌈log2(nb)⌉ steps because the error (I − LX) is strictly
+    lower (nilpotent): E_{k+1} = E_k².  All work is (nb × nb) MXU
+    matmuls — Mosaic has no triangular_solve."""
+    eye = jnp.eye(nb, dtype=L.dtype)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+    Lu = jnp.where(rows > cols, L, 0) + eye    # unit diagonal, clear U
+    X = 2 * eye - Lu                           # I − N seed
+    steps = max(1, (nb - 1).bit_length())
+    for _ in range(steps - 1):
+        X = X @ (2 * eye - Lu @ X)
+    return X
+
+
+def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
+                       *, wb: int, mb: int):
+    """Blocked right-looking partial LU of one front, VMEM-resident.
+
+    Same dataflow as ops/dense_lu.partial_lu: per nb-wide block —
+    rank-1 panel elimination restricted to the (mb, nb) panel, unit-
+    lower inverse of the diagonal block (Newton, MXU), U12 = L11⁻¹·A12
+    and trailing GEMM F22 −= L21·U12 both on the MXU.  The kb loop is
+    Python-unrolled (static slices); only the nb rank-1 steps per
+    block run as a fori_loop on the (mb, nb) panel, so VPU work is
+    O(wb·mb·nb) instead of the whole-front O(wb·mb²)."""
+    F = F_ref[0]
+    dtype = F.dtype
+    thresh = thresh_ref[0, 0].astype(dtype)
+    nb = _pick_nb(wb)
+    rows_m = jax.lax.broadcasted_iota(jnp.int32, (mb, 1), 0)
+    cols_nb = jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1)
+    tiny = jnp.zeros((), jnp.int32)
+    nzero = jnp.zeros((), jnp.int32)
+
+    for k0 in range(0, wb, nb):
+        panel = F[:, k0:k0 + nb]                        # (mb, nb)
+
+        def t_step(t, carry, k0=k0):
+            panel, tiny, nzero = carry
+            k = k0 + t
+            is_t = cols_nb == t                         # (1, nb)
+            ck = jnp.sum(jnp.where(is_t, panel, 0), axis=1,
+                         keepdims=True)                 # (mb, 1)
+            piv = jnp.sum(jnp.where(rows_m == k, ck, 0))
+            apiv = jnp.abs(piv)
+            is_tiny = apiv < thresh
+            sgn = jnp.where(piv >= 0, jnp.ones((), dtype),
+                            -jnp.ones((), dtype))
+            piv = jnp.where(is_tiny, sgn * thresh, piv)
+            was_zero = jnp.logical_and(apiv == 0,
+                                       jnp.logical_not(is_tiny))
+            below = rows_m > k
+            scaled = jnp.where(below, ck / piv, ck)
+            newcol = jnp.where(rows_m == k, piv, scaled)
+            panel = jnp.where(is_t, newcol, panel)
+            rk = jnp.sum(jnp.where(rows_m == k, panel, 0), axis=0,
+                         keepdims=True)                 # (1, nb)
+            upd = jnp.where(below, scaled, 0) @ jnp.where(
+                cols_nb > t, rk, 0)
+            panel = panel - upd
+            return (panel, tiny + is_tiny.astype(jnp.int32),
+                    nzero + was_zero.astype(jnp.int32))
+
+        panel, tiny, nzero = jax.lax.fori_loop(
+            0, nb, t_step, (panel, tiny, nzero))
+        F = jax.lax.dynamic_update_slice(F, panel, (0, k0))
+        rest = mb - k0 - nb
+        if rest > 0:
+            Inv = _unit_lower_inverse_newton(
+                panel[k0:k0 + nb, :], nb)
+            U12 = Inv @ F[k0:k0 + nb, k0 + nb:]         # (nb, rest)
+            L21 = panel[k0 + nb:, :]                    # (rest, nb)
+            F22 = F[k0 + nb:, k0 + nb:] - L21 @ U12
+            F = jax.lax.dynamic_update_slice(F, U12, (k0, k0 + nb))
+            F = jax.lax.dynamic_update_slice(F, F22,
+                                             (k0 + nb, k0 + nb))
+
+    out_ref[0] = F
+    tiny_ref[0] = tiny
+    nzero_ref[0] = nzero
+
+
 def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
                wb: int, mb: int):
     F = F_ref[0]
@@ -95,7 +190,13 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     thresh_arr = jnp.asarray(thresh, dtype=F.dtype).reshape(1, 1)
-    kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
+    # blocked kernel (MXU TRSM/GEMM per nb-wide panel) by default;
+    # SLU_TPU_PALLAS_COLUMN=1 falls back to the per-column rank-1
+    # kernel for A/B comparison
+    if os.environ.get("SLU_TPU_PALLAS_COLUMN", "0") == "1":
+        kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
+    else:
+        kern = functools.partial(_lu_kernel_blocked, wb=wb, mb=mb)
     out, tiny, nzero = pl.pallas_call(
         kern,
         grid=(N,),

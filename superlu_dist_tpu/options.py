@@ -119,6 +119,10 @@ class Options:
     # width cap for amalgamated supernodes (MAX_SUPER_SIZE analog)
     amalg_cap: int = dataclasses.field(
         default_factory=lambda: _env_int("SUPERLU_AMALG_CAP", 512))
+    # symbolic-factorization worker threads (symbfact_dist analog,
+    # SRC/psymbfact.c:150): 0 = auto, 1 = serial, k = exactly k
+    symb_threads: int = dataclasses.field(
+        default_factory=lambda: _env_int("SUPERLU_SYMB_THREADS", 0))
 
     # --- precision strategy (the psgssvx_d2 mixed mode, SRC/psgssvx_d2.c:516,
     # generalized: factor in `factor_dtype`, accumulate residuals in

@@ -147,7 +147,8 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         colcount = col_counts_postordered(b_indptr, b_indices, parent)
         part = find_supernodes(parent, colcount,
                                options.relax, options.max_super)
-        sym = symbolic_factorize(b_indptr, b_indices, part)
+        sym = symbolic_factorize(b_indptr, b_indices, part,
+                                 threads=options.symb_threads)
         sym = amalgamate(sym, options.amalg_tau, options.amalg_cap)
 
     # [Dist-plan] frontal maps (the pddistribute analog — here it

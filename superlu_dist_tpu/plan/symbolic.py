@@ -50,18 +50,31 @@ class SymbolicFactorization:
 
 
 def symbolic_factorize(b_indptr: np.ndarray, b_indices: np.ndarray,
-                       part: SupernodePartition) -> SymbolicFactorization:
+                       part: SupernodePartition,
+                       threads: int = 0) -> SymbolicFactorization:
     """B is the symmetrized pattern CSR in the final (postordered)
     column order.  Dispatches to the native union pass
-    (csrc/slu_host.cpp slu_symbfact_*); Python fallback below."""
+    (csrc/slu_host.cpp slu_symbfact_*); Python fallback below.
+
+    threads: 0 = auto (level-parallel native pass, the symbfact_dist
+    analog, when the supernode count justifies it), 1 = serial, k > 1
+    = exactly k worker threads.  Output is identical either way."""
     from ..utils.native import native_or_none
     native = native_or_none()
     if native is not None:
+        import os
         n = len(b_indptr) - 1
+        if threads == 0:
+            # auto: the union pass is memory-bandwidth-bound, so
+            # threads only pay off on patterns with very large
+            # supernode populations (audikw_1-class 3D meshes)
+            threads = (min(8, os.cpu_count() or 1)
+                       if part.nsuper >= 32768 else 1)
         struct = native.symbfact(
             n, b_indptr, b_indices, part.nsuper,
             np.ascontiguousarray(part.xsup, dtype=np.int64),
-            np.ascontiguousarray(part.sparent, dtype=np.int64))
+            np.ascontiguousarray(part.sparent, dtype=np.int64),
+            threads=threads)
         return SymbolicFactorization(
             part=part, struct=struct,
             children=_child_lists(part))

@@ -49,8 +49,8 @@ def _build() -> str | None:
     tmp = f"{out}.{os.getpid()}.tmp"  # unique: concurrent builds race
     try:
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", src,
-             "-o", tmp],
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
+             "-shared", src, "-o", tmp],
             check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
         return out
@@ -90,13 +90,17 @@ def _load():
             lib.slu_symbfact_create.argtypes = [
                 ctypes.c_int64, _I64, _I64, ctypes.c_int64, _I64, _I64]
             lib.slu_symbfact_create.restype = ctypes.c_void_p
+            lib.slu_symbfact_create_par.argtypes = [
+                ctypes.c_int64, _I64, _I64, ctypes.c_int64, _I64, _I64,
+                ctypes.c_int64]
+            lib.slu_symbfact_create_par.restype = ctypes.c_void_p
             lib.slu_symbfact_total.argtypes = [ctypes.c_void_p]
             lib.slu_symbfact_total.restype = ctypes.c_int64
             lib.slu_symbfact_sizes.argtypes = [ctypes.c_void_p, _I64]
             lib.slu_symbfact_fill.argtypes = [ctypes.c_void_p, _I64]
             lib.slu_symbfact_free.argtypes = [ctypes.c_void_p]
             lib.slu_version.restype = ctypes.c_int64
-            assert lib.slu_version() == 1
+            assert lib.slu_version() == 2
             _lib = lib
         except (OSError, AssertionError, AttributeError):
             _failed = True
@@ -189,15 +193,21 @@ def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
 
 
 def symbfact(n: int, b_indptr: np.ndarray, b_indices: np.ndarray,
-             nsuper: int, xsup: np.ndarray, sparent: np.ndarray):
+             nsuper: int, xsup: np.ndarray, sparent: np.ndarray,
+             threads: int = 1):
     """Supernodal symbolic factorization.  Returns a list of
-    per-supernode sorted off-block row index arrays."""
+    per-supernode sorted off-block row index arrays.  threads > 1
+    runs the level-parallel variant (identical output)."""
     lib = _load()
     _, pp = _c64(b_indptr)
     _, pi = _c64(b_indices)
     _, px = _c64(xsup)
     _, ps = _c64(sparent)
-    h = lib.slu_symbfact_create(n, pp, pi, nsuper, px, ps)
+    if threads > 1:
+        h = lib.slu_symbfact_create_par(n, pp, pi, nsuper, px, ps,
+                                        threads)
+    else:
+        h = lib.slu_symbfact_create(n, pp, pi, nsuper, px, ps)
     if not h:
         raise MemoryError("slu_symbfact_create failed")
     try:

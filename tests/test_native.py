@@ -143,6 +143,55 @@ def test_symbfact_matches_python():
         assert len(struct_c) == part.nsuper
         for s in range(part.nsuper):
             np.testing.assert_array_equal(sym_py.struct[s], struct_c[s])
+        # level-parallel variant (symbfact_dist analog) must be
+        # bit-identical to the serial pass
+        struct_p = native.symbfact(n, bpp, bpi, part.nsuper,
+                                   part.xsup, part.sparent, threads=4)
+        for s in range(part.nsuper):
+            np.testing.assert_array_equal(struct_c[s], struct_p[s])
+
+
+def test_symbfact_parallel_wide_level():
+    """Drive the threaded branch for real: ≥64 independent supernodes
+    at one etree level (the cnt<64 serial guard in
+    slu_symbfact_create_par would otherwise hide worker bugs)."""
+    import scipy.sparse as sp
+    rng = np.random.default_rng(11)
+    nb, bs = 96, 4                      # 96 independent dense blocks
+    blocks = []
+    for _ in range(nb):
+        d = np.abs(rng.standard_normal((bs, bs))) + np.eye(bs) * bs
+        blocks.append(sp.csr_matrix(d))
+    # couple every block's last column into one shared root column so
+    # the level-1 root depends on all 96 level-0 supernodes
+    A = sp.block_diag(blocks, format="lil")
+    n = nb * bs + 1
+    A.resize((n, n))
+    A[n - 1, n - 1] = 1.0
+    for k in range(nb):
+        A[k * bs + bs - 1, n - 1] = 1.0
+        A[n - 1, k * bs + bs - 1] = 1.0
+    b = A.tocsr()
+    b.sort_indices()
+    ip, ix = b.indptr.astype(np.int64), b.indices.astype(np.int64)
+    parent = etree_symmetric_py(ip, ix, n)
+    post = postorder_py(parent)
+    bp = b[post][:, post].tocsr()
+    bp.sort_indices()
+    par2 = relabel_tree(parent, post)
+    bpp = bp.indptr.astype(np.int64)
+    bpi = bp.indices.astype(np.int64)
+    cc = col_counts_postordered_py(bpp, bpi, par2)
+    part = find_supernodes(par2, cc, relax=1, max_super=bs)
+    assert part.nsuper >= 65, "pattern must give a wide level"
+    lev0 = int(np.sum(part.levels == part.levels.min()))
+    assert lev0 >= 64, f"widest level only {lev0} supernodes"
+    s1 = native.symbfact(n, bpp, bpi, part.nsuper, part.xsup,
+                         part.sparent, threads=1)
+    s4 = native.symbfact(n, bpp, bpi, part.nsuper, part.xsup,
+                         part.sparent, threads=4)
+    for a_, b_ in zip(s1, s4):
+        np.testing.assert_array_equal(a_, b_)
 
 
 def test_end_to_end_solve_with_native(laplacian_solver_check=None):

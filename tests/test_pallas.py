@@ -14,7 +14,13 @@ pytestmark = pytest.mark.skipif(not pallas_lu._HAVE_PALLAS,
 
 
 @pytest.mark.parametrize("mb,wb,n", [(16, 8, 3), (32, 32, 2),
-                                     (64, 16, 5)])
+                                     (64, 16, 5),
+                                     # multi-block panels (wb > nb=32)
+                                     (104, 64, 2), (128, 96, 1),
+                                     # non-pow2 width: _pick_nb(48)=24
+                                     (64, 48, 2),
+                                     # dense-root case wb == mb
+                                     (64, 64, 1)])
 def test_pallas_matches_xla(mb, wb, n):
     rng = np.random.default_rng(0)
     F = rng.standard_normal((n, mb, mb)).astype(np.float32)
