@@ -4,8 +4,8 @@ The reference sweeps equilibration × row perms × Fact reuse modes ×
 nrhs over CTest grid shapes (TEST/CMakeLists.txt:9-19), calling pdgssvx
 twice (prefactor then test) and checking the scaled residual
 ‖B−AX‖/(‖A‖·‖X‖·eps) plus berr.  This driver does the same sweep over
-backends and mesh-shape-independent options; tests/test_sweep.py runs
-a reduced matrix of it in CI.
+backends and mesh-shape-independent options; tests/test_drivers.py
+runs a reduced matrix of it in CI.
 
     python -m superlu_dist_tpu.drivers.pdtest            # built-in 5pt
     python -m superlu_dist_tpu.drivers.pdtest g20.rua

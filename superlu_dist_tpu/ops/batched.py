@@ -192,14 +192,13 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                     rbc = int(fp.mb[c]) - int(fp.wb[c])
                     coff = sup_upd_off[c]
                     assert coff >= 0, "child scheduled after parent"
-                    ii, jj = np.meshgrid(np.arange(rc), np.arange(rc),
-                                         indexing="ij")
+                    ar = np.arange(rc)
                     per_dev["ea_src"][d].append(
-                        coff + ii.ravel() * rbc + jj.ravel())
+                        (coff + ar[:, None] * rbc + ar[None, :]).ravel())
                     pos = _pad_pos(fp.ea_map[c], w, wb)
-                    pi, pj = np.meshgrid(pos, pos, indexing="ij")
                     per_dev["ea_dst"][d].append(
-                        base + pi.ravel() * mb + pj.ravel())
+                        (base + pos[:, None] * mb
+                         + pos[None, :]).ravel())
                 col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
                 struct_idx[d, b, :r] = fp.sym.struct[s]
                 # global update slab is device-major contiguous so an

@@ -81,6 +81,17 @@ def test_grid_factory():
         make_solver_mesh(4, 4, 4)
 
 
+def test_gridinit_multihost_single_process():
+    """Single-process degenerate case of the multi-host initializer:
+    same mesh as make_solver_mesh, no distributed runtime started."""
+    from superlu_dist_tpu.parallel.grid import gridinit_multihost
+    g = gridinit_multihost(2, 2, 2)
+    assert g.npdep == 2
+    assert dict(g.mesh.shape) == {"r": 2, "c": 2, "z": 2}
+    with pytest.raises(ValueError):
+        gridinit_multihost(4, 4, 4)
+
+
 def test_dist_backend_through_gssvx():
     """backend='dist': sharded factors persist, refinement and the
     FACTORED rung run over the mesh (the pdgssvx-on-a-grid contract)."""
