@@ -152,8 +152,54 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
 
     sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
     groups: List[GroupSpec] = []
-    upd_cursor = 0
     L_cur = U_cur = Li_cur = Ui_cur = 0
+
+    # liveness-based update-slab allocator: a group's slab is dead
+    # once every front in it has been consumed by its parent's
+    # extend-add, so slab address space is reused via a first-fit
+    # free list (the difference between O(sum of all slabs) and
+    # O(live working set) HBM for 3D-mesh problems, whose rb² update
+    # matrices dominate memory)
+    holes: List[tuple] = []          # (offset, size), disjoint, sorted
+    upd_peak = 0
+    group_alloc: dict = {}           # group idx -> (offset, size)
+    remaining: dict = {}             # group idx -> unconsumed fronts
+    group_of_sup: dict = {}          # front -> group idx
+
+    def _free(gi: int):
+        off, size = group_alloc[gi]
+        if size == 0:
+            return
+        holes.append((off, size))
+        holes.sort()
+        merged = [holes[0]]
+        for o, s in holes[1:]:       # coalesce adjacent holes
+            po, ps = merged[-1]
+            if po + ps == o:
+                merged[-1] = (po, ps + s)
+            else:
+                merged.append((o, s))
+        holes[:] = merged
+
+    def _alloc(size: int) -> int:
+        nonlocal upd_peak
+        if size == 0:
+            return 0
+        for i, (o, s) in enumerate(holes):
+            if s >= size:
+                if s == size:
+                    holes.pop(i)
+                else:
+                    holes[i] = (o + size, s - size)
+                return o
+        # reclaim the tail hole if it touches the peak
+        if holes and holes[-1][0] + holes[-1][1] == upd_peak:
+            o, s = holes.pop()
+            upd_peak = o + size
+            return o
+        o = upd_peak
+        upd_peak += size
+        return o
 
     for lv, sups in enumerate(fp.level_supernodes):
         by_bucket = {}
@@ -167,6 +213,20 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             n_tot = n_loc * ndev
             rb = mb - wb
             f_loc = n_loc * mb * mb
+
+            # consume child slabs (each front is extend-added exactly
+            # once, here); fully-consumed groups free their slab for
+            # reuse — overlap with this group's own slab is safe
+            # because the assembly reads happen before the slab write
+            # within one functional step
+            for s in slist:
+                for c in fp.sym.children[s]:
+                    if fp.r[c] > 0:
+                        gc = group_of_sup[c]
+                        remaining[gc] -= 1
+                        if remaining[gc] == 0:
+                            _free(gc)
+            upd_off = _alloc(n_tot * rb * rb)
 
             per_dev = {k: [[] for _ in range(ndev)]
                        for k in ("a_src", "a_dst", "one", "ea_src",
@@ -203,7 +263,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 struct_idx[d, b, :r] = fp.sym.struct[s]
                 # global update slab is device-major contiguous so an
                 # all_gather of local slabs reproduces it exactly
-                sup_upd_off[s] = upd_cursor + bg * rb * rb
+                sup_upd_off[s] = upd_off + bg * rb * rb
             # dummy fronts (including wholly idle devices): identity
             # pivot block so the padded LU is well-defined
             for bg in range(N, n_tot):
@@ -241,9 +301,16 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 ea_src=stack("ea_src", -1),      # finalized below
                 ea_dst=stack("ea_dst", f_loc),
                 col_idx=col_idx, struct_idx=struct_idx,
-                upd_off_global=upd_cursor,
+                upd_off_global=upd_off,
                 L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur))
-            upd_cursor += n_tot * rb * rb
+            gi = len(groups) - 1
+            group_alloc[gi] = (upd_off, n_tot * rb * rb)
+            for s in slist:
+                group_of_sup[s] = gi
+            nread = sum(1 for s in slist if fp.r[s] > 0)
+            remaining[gi] = nread
+            if nread == 0:
+                _free(gi)
             L_cur += n_loc * mb * wb
             U_cur += n_loc * wb * mb
             Li_cur += n_loc * wb * wb
@@ -251,10 +318,10 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
 
     # ea_src pads -> index of the zero slot appended at upd_total
     for g in groups:
-        g.ea_src[g.ea_src == -1] = upd_cursor
+        g.ea_src[g.ea_src == -1] = upd_peak
 
     return BatchedSchedule(groups=groups, ndev=ndev, n=n,
-                           upd_total=upd_cursor,
+                           upd_total=upd_peak,
                            L_total=L_cur, U_total=U_cur,
                            Li_total=Li_cur, Ui_total=Ui_cur)
 

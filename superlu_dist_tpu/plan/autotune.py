@@ -24,11 +24,18 @@ import numpy as np
 
 
 def _dp_buckets(values: np.ndarray, weights: np.ndarray,
-                max_buckets: int, cost_of) -> list:
+                max_buckets: int, power: float) -> list:
     """Choose ≤ max_buckets bucket boundaries from the unique sorted
-    `values` minimizing Σ weights·cost_of(bucket_value) where each
-    value maps to the smallest bucket ≥ it.  O(U²·K) DP — U is tiny
-    (distinct supernode sizes)."""
+    `values` minimizing the RELATIVE padding cost
+    Σ weights·(bucket/value)^power, where each value maps to the
+    smallest bucket ≥ it.  The relative form is essential: with an
+    absolute cost the handful of giant separator fronts dominates the
+    objective and the DP happily rounds thousands of small leaf
+    fronts up by 7× (a real failure observed on 3D meshes — 468 MB of
+    update-slab padding from one leaf group).  (bucket/value)^power is
+    the per-front flop AND memory inflation factor (power=1 for
+    widths, 2 for front sizes), so every front's padding is judged
+    against its own true cost.  O(U²·K) DP — U is tiny."""
     uniq = np.unique(values)
     U = len(uniq)
     if U == 0:
@@ -37,12 +44,14 @@ def _dp_buckets(values: np.ndarray, weights: np.ndarray,
     w_of = np.zeros(U)
     for v, wt in zip(values, weights):
         w_of[np.searchsorted(uniq, v)] += wt
-    # seg_cost[i][j]: cost of covering uniq[i..j] with bucket uniq[j]
-    seg = np.zeros((U, U))
+    # seg[i,j] = Σ_{t=i..j} w_of[t]·(uniq[j]/uniq[t])^p
+    #          = uniq[j]^p · prefix-sums of w_of[t]/uniq[t]^p
+    inv = w_of / np.maximum(uniq, 1).astype(float) ** power
+    cinv = np.concatenate([[0.0], np.cumsum(inv)])
+    seg = np.empty((U, U))
     for j in range(U):
-        c = cost_of(uniq[j])
-        for i in range(j + 1):
-            seg[i, j] = np.dot(w_of[i:j + 1], np.full(j - i + 1, c))
+        bp = float(uniq[j]) ** power
+        seg[:j + 1, j] = bp * (cinv[j + 1] - cinv[:j + 1])
     INF = np.inf
     dp = np.full((K + 1, U), INF)
     choice = np.zeros((K + 1, U), dtype=np.int64)
@@ -56,12 +65,12 @@ def _dp_buckets(values: np.ndarray, weights: np.ndarray,
                 if c < best:
                     best, arg = c, i
             dp[k, j], choice[k, j] = best, arg
-    # fewer buckets may tie; pick minimal k within 1% of the best cost
-    best_k = min(range(1, K + 1), key=lambda k: dp[k, U - 1])
-    for k in range(1, best_k):
-        if dp[k, U - 1] <= dp[best_k, U - 1] * 1.01:
-            best_k = k
-            break
+    # every bucket multiplies (level, bucket) groups — sequential
+    # dispatch steps on TPU — so an extra bucket must buy its keep:
+    # charge 3% of the no-padding cost (Σw, the cost floor) per bucket
+    lam = 0.03 * float(np.sum(w_of))
+    best_k = min(range(1, K + 1),
+                 key=lambda k: dp[k, U - 1] + lam * k)
     # backtrack
     out = []
     j = U - 1
@@ -88,8 +97,7 @@ def autotuned_options(plan, options=None, max_width_buckets: int = 10,
     # weight each supernode by its flop share so the DP optimizes where
     # the work is
     flops = w * w * m + w * (m - w) ** 2 + 1.0
-    wb = _dp_buckets(w, flops, max_width_buckets,
-                     cost_of=lambda wv: float(wv))
+    wb = _dp_buckets(w, flops, max_width_buckets, power=1.0)
 
     # legalize widths first: the blocked LU kernel needs wb ≤ 32 or
     # wb ≡ 0 mod 32 (dense_lu.partial_lu block size), and TPU tiles
@@ -106,8 +114,7 @@ def autotuned_options(plan, options=None, max_width_buckets: int = 10,
     wb_arr = np.asarray(wb)
     wb_of = wb_arr[np.searchsorted(wb_arr, w)]
     m_eff = np.maximum(wb_of + (m - w), m)
-    mb = _dp_buckets(m_eff, flops, max_front_buckets,
-                     cost_of=lambda mv: float(mv) ** 2)
+    mb = _dp_buckets(m_eff, flops, max_front_buckets, power=2.0)
     mb = sorted({-(-int(v) // 8) * 8 for v in mb})
     return options.replace(width_buckets=tuple(wb),
                            front_buckets=tuple(mb))
