@@ -58,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[m.name for m in Trans])
     p.add_argument("--no-equil", action="store_true")
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture a jax.profiler trace of the solve "
+                        "into DIR (the PROFlevel/VTune-hook analog; "
+                        "view with tensorboard or xprof)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="echo the effective options "
@@ -106,16 +110,24 @@ def main(argv=None) -> int:
 
     stats = Stats()
     nproc = args.nprow * args.npcol * args.npdep
-    if nproc > 1:
-        if args.backend != "auto" or args.fused:
-            raise SystemExit("-r/-c/-d > 1 selects the distributed "
-                             "backend; drop --backend/--fused")
-        x = _solve_distributed(a, b, opts, args, stats)
-    elif args.fused:
-        x = _solve_fused(a, b, opts, stats)
-    else:
-        x, _, stats = gssvx(opts, a, b, stats=stats,
-                            backend=args.backend)
+
+    import contextlib
+    prof: contextlib.AbstractContextManager = contextlib.nullcontext()
+    if args.profile:
+        import jax
+        prof = jax.profiler.trace(args.profile)
+
+    with prof:
+        if nproc > 1:
+            if args.backend != "auto" or args.fused:
+                raise SystemExit("-r/-c/-d > 1 selects the distributed "
+                                 "backend; drop --backend/--fused")
+            x = _solve_distributed(a, b, opts, args, stats)
+        elif args.fused:
+            x = _solve_fused(a, b, opts, stats)
+        else:
+            x, _, stats = gssvx(opts, a, b, stats=stats,
+                                backend=args.backend)
 
     err = np.max(np.abs(x - xtrue)) / max(np.max(np.abs(xtrue)), 1e-300)
     if not args.quiet:
