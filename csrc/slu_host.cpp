@@ -418,6 +418,223 @@ int64_t slu_mc64(int64_t n, const int64_t* colptr, const int64_t* rowind,
   return 0;
 }
 
+// ------------------------------------------- nested dissection ordering
+// BFS level-set bisection nested dissection, the METIS_AT_PLUS_A /
+// ParMETIS slot of get_perm_c_dist (reference SRC/get_perm_c.c:91,489;
+// SRC/get_perm_c_parmetis.c:255).  Mirrors the numpy implementation in
+// superlu_dist_tpu/plan/nested.py step for step (same BFS level sets,
+// same pseudo-peripheral restarts, same median split, same emit order),
+// so the two produce IDENTICAL orderings — the Python version is the
+// test oracle.  The two recursion halves write disjoint output ranges,
+// so the top recursion levels fan out over std::thread (the
+// process-parallel-ordering analog of ParMETIS).
+
+}  // extern "C" — the ND internals are C++-linkage
+
+namespace nd {
+
+struct Graph {
+  std::vector<int64_t> ip, ix, labels;
+};
+
+// BFS from src on local graph of k nodes; fills level; returns
+// eccentricity (max level reached)
+static int64_t bfs(const Graph& g, int64_t k, int64_t src,
+                   std::vector<int64_t>& level,
+                   std::vector<int64_t>& frontier,
+                   std::vector<int64_t>& next) {
+  std::fill(level.begin(), level.begin() + k, -1);
+  level[src] = 0;
+  frontier.clear();
+  frontier.push_back(src);
+  int64_t lev = 0;
+  while (!frontier.empty()) {
+    ++lev;
+    next.clear();
+    for (int64_t u : frontier)
+      for (int64_t p = g.ip[u]; p < g.ip[u + 1]; ++p) {
+        int64_t v = g.ix[p];
+        if (level[v] == -1) { level[v] = lev; next.push_back(v); }
+      }
+    frontier.swap(next);
+  }
+  int64_t ecc = 0;
+  for (int64_t i = 0; i < k; ++i) ecc = std::max(ecc, level[i]);
+  return ecc;
+}
+
+// induced subgraph of the sorted local-node list `part`
+static Graph subgraph(const Graph& g, const std::vector<int64_t>& part,
+                      std::vector<int64_t>& posmap) {
+  Graph s;
+  int64_t m = (int64_t)part.size();
+  for (int64_t i = 0; i < m; ++i) posmap[part[i]] = i;
+  s.ip.resize(m + 1);
+  s.ip[0] = 0;
+  int64_t nnz = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t u = part[i];
+    for (int64_t p = g.ip[u]; p < g.ip[u + 1]; ++p)
+      if (posmap[g.ix[p]] >= 0) ++nnz;
+    s.ip[i + 1] = nnz;
+  }
+  s.ix.resize(nnz);
+  int64_t c = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t u = part[i];
+    for (int64_t p = g.ip[u]; p < g.ip[u + 1]; ++p) {
+      int64_t v = posmap[g.ix[p]];
+      if (v >= 0) s.ix[c++] = v;
+    }
+  }
+  s.labels.resize(m);
+  for (int64_t i = 0; i < m; ++i) s.labels[i] = g.labels[part[i]];
+  for (int64_t i = 0; i < m; ++i) posmap[part[i]] = -1;  // reset
+  return s;
+}
+
+// Iterative driver with an explicit work list — NO recursion per
+// component or per bisection level (a graph with 10^5 components or a
+// path graph must not overflow the C stack).  The only recursion is
+// the std::thread fan-out, bounded by par_depth ≤ log2(nthreads).
+static void solve(Graph g0, int64_t* out, int64_t pos0, int64_t leaf,
+                  int par_depth) {
+  std::vector<std::pair<Graph, int64_t>> todo;
+  todo.emplace_back(std::move(g0), pos0);
+  std::vector<std::thread> spawned;
+  std::vector<int64_t> level, frontier, next, posmap, a, b, sep;
+
+  while (!todo.empty()) {
+    Graph g = std::move(todo.back().first);
+    int64_t pos = todo.back().second;
+    todo.pop_back();
+    for (;;) {
+      int64_t k = (int64_t)g.labels.size();
+      if (k <= leaf) {
+        std::memcpy(out + pos, g.labels.data(), k * sizeof(int64_t));
+        break;
+      }
+      level.assign(k, -1);
+      frontier.clear();
+      next.clear();
+      int64_t src = 0, last_ecc = -1;
+      int64_t ecc = bfs(g, k, src, level, frontier, next);
+      for (int it = 0; it < 4; ++it) {
+        if (ecc <= last_ecc) break;
+        last_ecc = ecc;
+        for (int64_t i = 0; i < k; ++i)
+          if (level[i] == ecc) { src = i; break; }
+        ecc = bfs(g, k, src, level, frontier, next);
+      }
+      posmap.assign(k, -1);
+      a.clear();
+      b.clear();
+      bool disconnected = false;
+      for (int64_t i = 0; i < k; ++i)
+        if (level[i] < 0) { disconnected = true; break; }
+      if (disconnected) {
+        // label ALL components in one O(nnz) pass (ascending seed
+        // order = the oracle's peel order, so output is identical,
+        // without the oracle's O(#components²) peel cost)
+        std::vector<int64_t> comp(k, -1);
+        std::vector<std::vector<int64_t>> parts;
+        for (int64_t i = 0; i < k; ++i) {
+          if (comp[i] >= 0) continue;
+          int64_t c = (int64_t)parts.size();
+          parts.emplace_back();
+          comp[i] = c;
+          frontier.clear();
+          frontier.push_back(i);
+          parts[c].push_back(i);
+          while (!frontier.empty()) {
+            next.clear();
+            for (int64_t u : frontier)
+              for (int64_t p2 = g.ip[u]; p2 < g.ip[u + 1]; ++p2) {
+                int64_t v = g.ix[p2];
+                if (comp[v] < 0) {
+                  comp[v] = c;
+                  parts[c].push_back(v);
+                  next.push_back(v);
+                }
+              }
+            frontier.swap(next);
+          }
+          std::sort(parts[c].begin(), parts[c].end());
+        }
+        Graph first;
+        int64_t off = pos;
+        for (size_t c = 0; c < parts.size(); ++c) {
+          Graph s = subgraph(g, parts[c], posmap);
+          if (c == 0)
+            first = std::move(s);
+          else
+            todo.emplace_back(std::move(s), off);
+          off += (int64_t)parts[c].size();
+        }
+        g = std::move(first);         // component of node 0, at `pos`
+        continue;
+      }
+      int64_t maxlev = ecc;
+      if (maxlev < 2) {
+        std::memcpy(out + pos, g.labels.data(), k * sizeof(int64_t));
+        break;
+      }
+      // median split of the level structure (first cum ≥ k/2, clipped)
+      std::vector<int64_t> counts(maxlev + 1, 0);
+      for (int64_t i = 0; i < k; ++i) ++counts[level[i]];
+      int64_t split = maxlev - 1, cum = 0;
+      for (int64_t l = 0; l <= maxlev; ++l) {
+        cum += counts[l];
+        if (2 * cum >= k) { split = l; break; }
+      }
+      split = std::max<int64_t>(1, std::min(split, maxlev - 1));
+      sep.clear();
+      for (int64_t i = 0; i < k; ++i) {
+        if (level[i] < split) a.push_back(i);
+        else if (level[i] > split) b.push_back(i);
+        else sep.push_back(i);
+      }
+      Graph left = subgraph(g, a, posmap);
+      Graph right = subgraph(g, b, posmap);
+      int64_t nl = (int64_t)a.size(), nr = (int64_t)b.size();
+      for (size_t i = 0; i < sep.size(); ++i)
+        out[pos + nl + nr + (int64_t)i] = g.labels[sep[i]];
+      g = Graph();
+      if (par_depth > 0 && nl > leaf && nr > leaf) {
+        // bounded recursion: ≤ log2(nthreads) nested solve frames
+        spawned.emplace_back(
+            [r = std::move(right), out, p = pos + nl, leaf,
+             par_depth]() mutable {
+              solve(std::move(r), out, p, leaf, par_depth - 1);
+            });
+        --par_depth;
+      } else {
+        todo.emplace_back(std::move(right), pos + nl);
+      }
+      g = std::move(left);            // keep going at `pos`
+    }
+  }
+  for (auto& t : spawned) t.join();
+}
+
+}  // namespace nd
+
+extern "C" {
+
+int64_t slu_ndorder(int64_t n, const int64_t* indptr,
+                    const int64_t* indices, int64_t leaf,
+                    int64_t nthreads, int64_t* out) {
+  nd::Graph g;
+  g.ip.assign(indptr, indptr + n + 1);
+  g.ix.assign(indices, indices + indptr[n]);
+  g.labels.resize(n);
+  for (int64_t i = 0; i < n; ++i) g.labels[i] = i;
+  int par_depth = 0;
+  while ((int64_t(1) << (par_depth + 1)) <= nthreads) ++par_depth;
+  nd::solve(std::move(g), out, 0, leaf, par_depth);
+  return n;
+}
+
 // ------------------------------------------------------------- symbfact
 // Supernodal symbolic factorization: per-supernode union pass over the
 // postordered supernodal etree (the reference's symbfact computes the
@@ -547,6 +764,6 @@ void slu_symbfact_free(void* handle) {
   delete static_cast<SymbHandle*>(handle);
 }
 
-int64_t slu_version() { return 2; }
+int64_t slu_version() { return 3; }
 
 }  // extern "C"

@@ -123,6 +123,12 @@ class Options:
     # SRC/psymbfact.c:150): 0 = auto, 1 = serial, k = exactly k
     symb_threads: int = dataclasses.field(
         default_factory=lambda: _env_int("SUPERLU_SYMB_THREADS", 0))
+    # nested-dissection recursion-half threads (the ParMETIS-slot
+    # parallel ordering).  Default 1: the single-threaded native pass
+    # is already ~80x the numpy oracle and threads only pay off on
+    # much larger graphs than the bench family.
+    nd_threads: int = dataclasses.field(
+        default_factory=lambda: _env_int("SUPERLU_ND_THREADS", 1))
 
     # --- precision strategy (the psgssvx_d2 mixed mode, SRC/psgssvx_d2.c:516,
     # generalized: factor in `factor_dtype`, accumulate residuals in

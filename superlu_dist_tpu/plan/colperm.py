@@ -32,16 +32,19 @@ def symmetrize_pattern(a: CSRMatrix) -> sp.csr_matrix:
     return b
 
 
-def _fill_reducing_order(b: sp.csr_matrix, mode: ColPerm) -> np.ndarray:
+def _fill_reducing_order(b: sp.csr_matrix, mode: ColPerm,
+                         nd_threads: int = 1) -> np.ndarray:
     from . import mindeg, nested
     n = b.shape[0]
     if mode in (ColPerm.METIS_AT_PLUS_A, ColPerm.PARMETIS):
-        return nested.nd_order(b.indptr, b.indices, n)
+        return nested.nd_order(b.indptr, b.indices, n,
+                               threads=nd_threads)
     return mindeg.amd_order(b.indptr, b.indices, n)
 
 
 def get_perm_c(a: CSRMatrix, mode: ColPerm,
-               user_perm_c: np.ndarray | None = None) -> np.ndarray:
+               user_perm_c: np.ndarray | None = None,
+               nd_threads: int = 1) -> np.ndarray:
     """Returns perm_c with perm_c[j] = new position of column j."""
     n = a.n
     if mode == ColPerm.NATURAL:
@@ -70,7 +73,7 @@ def get_perm_c(a: CSRMatrix, mode: ColPerm,
         return perm_c
     if mode in (ColPerm.MMD_AT_PLUS_A, ColPerm.MMD_ATA, ColPerm.AMD,
                 ColPerm.COLAMD, ColPerm.METIS_AT_PLUS_A, ColPerm.PARMETIS):
-        order = _fill_reducing_order(b, mode)
+        order = _fill_reducing_order(b, mode, nd_threads)
         perm_c = np.empty(n, dtype=np.int64)
         perm_c[order] = np.arange(n)
         return perm_c

@@ -88,8 +88,25 @@ def _induced_subgraph(indptr, indices, nodes):
 
 
 def nd_order(indptr: np.ndarray, indices: np.ndarray, n: int,
-             leaf_size: int = 48) -> np.ndarray:
-    """Returns order[k] = k-th pivot (old label)."""
+             leaf_size: int = 48, threads: int = 1) -> np.ndarray:
+    """Returns order[k] = k-th pivot (old label).  Dispatches to the
+    native C++ pass (csrc/slu_host.cpp slu_ndorder — thread-parallel
+    recursion halves, the ParMETIS-slot parallel ordering); this numpy
+    implementation is the fallback and the bit-identical test oracle.
+    `threads` comes from Options.nd_threads (SUPERLU_ND_THREADS)."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices, dtype=np.int64)
+    from ..utils.native import native_or_none
+    native = native_or_none()
+    if native is not None:
+        return native.nd_order(indptr, indices, n, leaf_size,
+                               max(1, threads))
+    return nd_order_py(indptr, indices, n, leaf_size)
+
+
+def nd_order_py(indptr: np.ndarray, indices: np.ndarray, n: int,
+                leaf_size: int = 48) -> np.ndarray:
+    """Pure-numpy recursive bisection (oracle/fallback)."""
     indptr = np.asarray(indptr, dtype=np.int64)
     indices = np.asarray(indices, dtype=np.int64)
     out = np.empty(n, dtype=np.int64)

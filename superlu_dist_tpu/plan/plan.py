@@ -110,7 +110,8 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         perm_c = colperm_mod.get_perm_c(
             CSRMatrix(n, n, a_rp.indptr.astype(np.int64),
                       a_rp.indices.astype(np.int64), a_rp.data),
-            options.col_perm, user_perm_c)
+            options.col_perm, user_perm_c,
+            nd_threads=options.nd_threads)
 
     # rows/cols after Pr then symmetric Pc
     r1 = perm_c[perm_r[coo_rows]]

@@ -99,8 +99,12 @@ def _load():
             lib.slu_symbfact_sizes.argtypes = [ctypes.c_void_p, _I64]
             lib.slu_symbfact_fill.argtypes = [ctypes.c_void_p, _I64]
             lib.slu_symbfact_free.argtypes = [ctypes.c_void_p]
+            lib.slu_ndorder.argtypes = [ctypes.c_int64, _I64, _I64,
+                                        ctypes.c_int64, ctypes.c_int64,
+                                        _I64]
+            lib.slu_ndorder.restype = ctypes.c_int64
             lib.slu_version.restype = ctypes.c_int64
-            assert lib.slu_version() == 2
+            assert lib.slu_version() == 3
             _lib = lib
         except (OSError, AssertionError, AttributeError):
             _failed = True
@@ -190,6 +194,22 @@ def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
     if rc != 0:
         raise ValueError("structurally singular matrix (native mc64)")
     return perm, u, v
+
+
+def nd_order(indptr: np.ndarray, indices: np.ndarray, n: int,
+             leaf_size: int = 48, threads: int = 1) -> np.ndarray:
+    """Nested-dissection ordering; returns order[k] = k-th pivot.
+    Identical output to plan/nested.nd_order (the oracle); threads > 1
+    fans the recursion halves over std::thread."""
+    lib = _load()
+    _, pp = _c64(indptr)
+    _, pi = _c64(indices)
+    out = np.empty(n, dtype=np.int64)
+    got = lib.slu_ndorder(n, pp, pi, leaf_size, threads,
+                          out.ctypes.data_as(_I64))
+    if got != n:
+        raise RuntimeError(f"native ndorder returned {got} of {n}")
+    return out
 
 
 def symbfact(n: int, b_indptr: np.ndarray, b_indices: np.ndarray,

@@ -41,6 +41,26 @@ def test_dist_matches_truth_and_mesh_invariance(ndev):
     np.testing.assert_allclose(xs, xtrue, rtol=1e-8, atol=1e-8)
 
 
+def test_dist_complex():
+    """Complex (z-precision) system over a mesh — pzdrive3d parity."""
+    a_r = convection_diffusion_2d(8)
+    rng = np.random.default_rng(7)
+    from superlu_dist_tpu.sparse import CSRMatrix
+    data = a_r.data + 1j * rng.standard_normal(len(a_r.data)) * 0.1
+    a = CSRMatrix(a_r.m, a_r.n, a_r.indptr, a_r.indices, data)
+    plan = plan_factorization(a, Options(factor_dtype="complex128"))
+    xtrue = (rng.standard_normal(a.n)
+             + 1j * rng.standard_normal(a.n))
+    b = a.to_scipy() @ xtrue
+    mesh = _mesh_1d(4)
+    step, _ = make_dist_step(plan, mesh, dtype=np.complex128)
+    bf = np.empty_like(b)
+    bf[plan.final_row] = b * plan.row_scale
+    x = np.asarray(step(plan.scaled_values(a), bf[:, None]))
+    xs = x[plan.final_col][:, 0] * plan.col_scale
+    np.testing.assert_allclose(xs, xtrue, rtol=1e-8, atol=1e-8)
+
+
 def test_dist_unsymmetric():
     a = convection_diffusion_2d(10)
     plan = plan_factorization(a, Options())

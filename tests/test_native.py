@@ -151,6 +151,53 @@ def test_symbfact_matches_python():
             np.testing.assert_array_equal(struct_c[s], struct_p[s])
 
 
+def test_ndorder_matches_python_oracle():
+    """Native nested dissection must be BIT-IDENTICAL to the numpy
+    implementation (same BFS level sets, same pseudo-peripheral
+    restarts, same median split, same emit order), threaded or not."""
+    from superlu_dist_tpu.plan.nested import nd_order_py
+    from superlu_dist_tpu.plan.colperm import symmetrize_pattern
+    from superlu_dist_tpu.utils.testmat import (laplacian_2d,
+                                                convection_diffusion_2d)
+    import scipy.sparse as sp
+    from superlu_dist_tpu.sparse import csr_from_scipy
+    cases = [laplacian_2d(40), convection_diffusion_2d(25),
+             csr_from_scipy((sp.random(300, 300, density=0.02,
+                                       random_state=3)
+                             + sp.eye(300)).tocsr())]
+    for a in cases:
+        b = symmetrize_pattern(a)
+        o_py = nd_order_py(b.indptr, b.indices, a.n)
+        for th in (1, 4):
+            o_c = native.nd_order(b.indptr, b.indices, a.n, threads=th)
+            np.testing.assert_array_equal(o_py, o_c)
+        assert np.array_equal(np.sort(o_c), np.arange(a.n))
+
+
+def test_ndorder_disconnected():
+    """Many components: must not recurse per component (stack) nor
+    peel one component per BFS (quadratic); output matches oracle."""
+    import scipy.sparse as sp
+    from superlu_dist_tpu.plan.nested import nd_order_py
+    # 2000 isolated vertices — pure component-labeling path
+    n = 2000
+    ip = np.arange(n + 1, dtype=np.int64)
+    ix = np.arange(n, dtype=np.int64)
+    o = native.nd_order(ip, ix, n, threads=1)
+    assert np.array_equal(np.sort(o), np.arange(n))
+    # mixed component sizes, threaded and not, vs oracle
+    blocks = [sp.random(30, 30, density=0.15, random_state=i)
+              + sp.eye(30) for i in range(8)]
+    A = sp.block_diag(blocks).tocsr()
+    B = ((A + A.T) != 0).astype(float).tocsr()
+    bp = B.indptr.astype(np.int64)
+    bi = B.indices.astype(np.int64)
+    o_py = nd_order_py(bp, bi, B.shape[0])
+    for th in (1, 4):
+        np.testing.assert_array_equal(
+            o_py, native.nd_order(bp, bi, B.shape[0], threads=th))
+
+
 def test_symbfact_parallel_wide_level():
     """Drive the threaded branch for real: ≥64 independent supernodes
     at one etree level (the cnt<64 serial guard in
