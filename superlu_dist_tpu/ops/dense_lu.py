@@ -47,62 +47,72 @@ def _tiny_replace(piv, thresh, dtype):
 @functools.partial(jax.jit, static_argnames=("wb", "nb"))
 def partial_lu(F, thresh, *, wb: int, nb: int = 32):
     """Factor the leading `wb` columns of the square front F (mb×mb) in
-    place: returns (F', tiny_count) where F' holds L (unit lower, cols
-    < wb), U (upper, rows < wb) and the Schur complement F'[wb:, wb:].
+    place: returns (F', tiny_count, zero_pivot_count) where F' holds L
+    (unit lower, cols < wb), U (upper, rows < wb) and the Schur
+    complement F'[wb:, wb:].
     `thresh` is the tiny-pivot threshold (0 disables replacement —
-    pass a tiny positive to keep the guard)."""
+    pass a tiny positive to keep the guard).
+
+    The sequential rank-1 elimination loop runs on the (nb, nb)
+    diagonal block ONLY; the column panel (L21 = A21·U11⁻¹), row panel
+    (U12 = L11⁻¹·A12) and trailing update are batched triangular
+    solves and one GEMM per block — O(nb²) work per sequential step
+    instead of O(mb·nb), with the mb-sized dimension entirely on
+    matrix units."""
     mb = F.shape[-1]
     dtype = F.dtype
     nb = min(nb, wb)
     assert wb % nb == 0, "width buckets must be multiples of the block"
     rows = jnp.arange(mb)
+    rows_nb = jnp.arange(nb)
 
-    def panel_step(t, carry):
-        """Eliminate column k0+t inside the (mb, nb) panel."""
-        panel, k0, tiny, nzero = carry
-        k = k0 + t
+    def d_step(t, carry):
+        """Eliminate column t of the (nb, nb) diagonal block."""
+        D, tiny, nzero = carry
         piv = jax.lax.dynamic_index_in_dim(
-            jax.lax.dynamic_index_in_dim(panel, k, axis=0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(D, t, axis=0, keepdims=False),
             t, axis=0, keepdims=False)
         piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
-        col = jax.lax.dynamic_index_in_dim(panel, t, axis=1,
-                                           keepdims=False)
-        below = rows > k
+        col = jax.lax.dynamic_index_in_dim(D, t, axis=1, keepdims=False)
+        below = rows_nb > t
         scaled = jnp.where(below, col / piv, col)
-        # write back the scaled column and the (possibly replaced) pivot
-        scaled = jnp.where(rows == k, piv, scaled)
-        panel = jax.lax.dynamic_update_index_in_dim(
-            panel, scaled, t, axis=1)
-        # rank-1 update of the panel columns to the right
-        rowvec = jax.lax.dynamic_index_in_dim(panel, k, axis=0,
+        scaled = jnp.where(rows_nb == t, piv, scaled)
+        D = jax.lax.dynamic_update_index_in_dim(D, scaled, t, axis=1)
+        rowvec = jax.lax.dynamic_index_in_dim(D, t, axis=0,
                                               keepdims=False)
-        colmask = jnp.arange(panel.shape[1]) > t
         upd = jnp.outer(jnp.where(below, scaled, 0),
-                        jnp.where(colmask, rowvec, 0))
-        panel = panel - upd
-        return panel, k0, tiny + was_tiny, nzero + was_zero
+                        jnp.where(rows_nb > t, rowvec, 0))
+        D = D - upd
+        return D, tiny + was_tiny, nzero + was_zero
 
     def block_step(kb, carry):
         F, tiny, nzero = carry
         k0 = kb * nb
-        panel = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
-        panel, _, tiny, nzero = jax.lax.fori_loop(
-            0, nb, panel_step, (panel, k0, tiny, nzero))
-        F = jax.lax.dynamic_update_slice(F, panel, (0, k0))
-        # TRSM: U block row — unit-lower solve of L11 against the full
-        # row slice, merged back only for columns ≥ k0+nb
-        L11 = jax.lax.dynamic_slice(F, (k0, k0), (nb, nb))
-        R = jax.lax.dynamic_slice(F, (k0, 0), (nb, mb))
-        X = jax.lax.linalg.triangular_solve(
-            L11, R, left_side=True, lower=True, unit_diagonal=True)
-        keep = (jnp.arange(mb) >= k0 + nb)[None, :]
-        R2 = jnp.where(keep, X, R)
-        F = jax.lax.dynamic_update_slice(F, R2, (k0, 0))
-        # trailing GEMM: F -= Lcol·Urow restricted to i,j ≥ k0+nb via
-        # masking (zero rows/cols contribute nothing)
-        Lcol = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
-        Lcol = jnp.where((rows >= k0 + nb)[:, None], Lcol, 0)
-        Urow = jnp.where(keep, R2, 0)
+        D = jax.lax.dynamic_slice(F, (k0, k0), (nb, nb))
+        D, tiny, nzero = jax.lax.fori_loop(0, nb, d_step,
+                                           (D, tiny, nzero))
+        F = jax.lax.dynamic_update_slice(F, D, (k0, k0))
+        tri = jnp.where(rows_nb[:, None] > rows_nb[None, :], D, 0)
+        L11 = tri + jnp.eye(nb, dtype=dtype)
+        U11 = D - tri
+        # L21 = A21 · U11⁻¹ over the full column slice; keep rows ≥
+        # k0+nb (rows < k0 hold finished U entries, D already written)
+        colp = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
+        L21 = jax.lax.linalg.triangular_solve(
+            U11, colp, left_side=False, lower=False)
+        keep_r = (rows >= k0 + nb)[:, None]
+        colp2 = jnp.where(keep_r, L21, colp)
+        F = jax.lax.dynamic_update_slice(F, colp2, (0, k0))
+        # U12 = L11⁻¹ · A12 over the full row slice
+        rowp = jax.lax.dynamic_slice(F, (k0, 0), (nb, mb))
+        U12 = jax.lax.linalg.triangular_solve(
+            L11, rowp, left_side=True, lower=True, unit_diagonal=True)
+        keep_c = (rows >= k0 + nb)[None, :]
+        rowp2 = jnp.where(keep_c, U12, rowp)
+        F = jax.lax.dynamic_update_slice(F, rowp2, (k0, 0))
+        # trailing GEMM restricted to i, j ≥ k0+nb via masking
+        Lcol = jnp.where(keep_r, colp2, 0)
+        Urow = jnp.where(keep_c, rowp2, 0)
         F = F - Lcol @ Urow
         return F, tiny, nzero
 
