@@ -14,8 +14,12 @@ vs_baseline = speedup of that step over scipy.sparse.linalg.splu+solve
               mixed-precision design targets (SURVEY.md §2.6
               psgssvx_d2 strategy).
 
-Matrix: 5-point Laplacian, the reference TEST-sweep generator family
-(TEST/CMakeLists.txt NVAL), at n = 25 600.
+Matrix: 7-point 3D Laplacian at n = 27 000 (the fill-heavy separator
+population of the audikw_1-class baseline config #3; scipy SuperLU
+needs ~5 s for its 14 GFLOP factorization, the regime where the MXU
+flop advantage shows).  SLU_BENCH_SHAPE=2d switches to the 5-point
+family of the reference TEST sweep (TEST/CMakeLists.txt NVAL);
+SLU_BENCH_K overrides the grid edge.
 """
 
 import json
@@ -31,6 +35,16 @@ def main():
 
     import jax
     import jax.numpy as jnp
+    # the ambient environment may register a default accelerator
+    # platform that overrides JAX_PLATFORMS; re-assert the caller's
+    # explicit choice so `JAX_PLATFORMS=cpu python bench.py` works
+    # even when the accelerator tunnel is unreachable
+    envp = os.environ.get("JAX_PLATFORMS")
+    if envp:
+        try:
+            jax.config.update("jax_platforms", envp)
+        except Exception:
+            pass
     try:
         # persistent compilation cache: repeated bench runs (and the
         # per-round driver invocation) skip the fused-program compile
@@ -44,10 +58,23 @@ def main():
     from superlu_dist_tpu.ops.batched import make_fused_solver
     from superlu_dist_tpu.plan.plan import plan_factorization
     from superlu_dist_tpu.utils.testmat import (laplacian_2d,
+                                                laplacian_3d,
                                                 manufactured_rhs)
 
-    k = int(os.environ.get("SLU_BENCH_K", "160"))
-    a = laplacian_2d(k)
+    # default: 7-point 3D Laplacian (the fill-heavy separator
+    # population of the audikw_1-class baseline config #3) — the
+    # regime direct solvers are built for and where the MXU flops
+    # dominate; SLU_BENCH_SHAPE=2d reverts to the 5-point family
+    # (the reference TEST generator, TEST/CMakeLists.txt NVAL)
+    shape = os.environ.get("SLU_BENCH_SHAPE", "3d")
+    if shape == "3d":
+        k = int(os.environ.get("SLU_BENCH_K", "30"))
+        a = laplacian_3d(k)
+        desc = f"3D Laplacian n={k ** 3}"
+    else:
+        k = int(os.environ.get("SLU_BENCH_K", "160"))
+        a = laplacian_2d(k)
+        desc = f"2D Laplacian n={k * k}"
     xtrue, b = manufactured_rhs(a)
 
     # --- baseline: scipy SuperLU (serial CPU, f64) ---
@@ -86,7 +113,7 @@ def main():
     gflops = plan.factor_flops / best / 1e9
     print(json.dumps({
         "metric": "fused sparse LU solve throughput "
-                  f"(2D Laplacian n={k * k}, f32 factor + f64 device "
+                  f"({desc}, f32 factor + f64 device "
                   f"IR; relerr {relerr:.1e} vs scipy {ref_relerr:.1e}; "
                   f"plan {t_plan:.2f}s warmup {t_warm:.1f}s"
                   + ("" if accuracy_ok else "; ACCURACY CHECK FAILED")

@@ -14,8 +14,10 @@ cost is pure VPU work:
 
 Gating: off by default until validated on real hardware; enable with
 SLU_TPU_PALLAS=1 (force, any platform via interpret on CPU) — see
-`enabled()`.  Semantics match ops/dense_lu.partial_lu exactly
-(tests/test_pallas.py compares them elementwise).
+`enabled()`.  The factorization computed agrees with
+ops/dense_lu.partial_lu to rounding (the two use different but
+algebraically equivalent block formulations; tests/test_pallas.py
+compares them elementwise under a small tolerance).
 """
 
 from __future__ import annotations
@@ -79,10 +81,12 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
                        *, wb: int, mb: int):
     """Blocked right-looking partial LU of one front, VMEM-resident.
 
-    Same dataflow as ops/dense_lu.partial_lu: per nb-wide block —
-    rank-1 panel elimination restricted to the (mb, nb) panel, unit-
-    lower inverse of the diagonal block (Newton, MXU), U12 = L11⁻¹·A12
-    and trailing GEMM F22 −= L21·U12 both on the MXU.  The kb loop is
+    Per nb-wide block: rank-1 panel elimination restricted to the
+    (mb, nb) panel, unit-lower inverse of the diagonal block (Newton,
+    MXU), U12 = L11⁻¹·A12 and trailing GEMM F22 −= L21·U12 both on
+    the MXU.  (dense_lu.partial_lu uses a different but algebraically
+    equivalent split — diagonal-block elimination + two triangular
+    solves; results agree to rounding.)  The kb loop is
     Python-unrolled (static slices); only the nb rank-1 steps per
     block run as a fori_loop on the (mb, nb) panel, so VPU work is
     O(wb·mb·nb) instead of the whole-front O(wb·mb²)."""
