@@ -418,6 +418,65 @@ int64_t slu_mc64(int64_t n, const int64_t* colptr, const int64_t* rowind,
   return 0;
 }
 
+// ---------------------------------------------------------- supernodes
+// Supernode partition: relaxed leaf subtrees + fundamental supernodes
+// (reference relax_snode / sp_ienv(2); mirrors
+// superlu_dist_tpu/plan/supernodes.py find_supernodes step for step —
+// the Python version is the bit-identical oracle).  Returns nsuper;
+// fills supno (n), xsup (first ns+1 slots), sparent (first ns slots).
+int64_t slu_supernodes(int64_t n, const int64_t* parent,
+                       const int64_t* colcount, int64_t relax,
+                       int64_t max_super, int64_t* supno,
+                       int64_t* xsup, int64_t* sparent) {
+  if (n == 0) { xsup[0] = 0; return 0; }
+  relax = std::max<int64_t>(1, std::min(relax, max_super));
+  std::vector<int64_t> size(n, 1);
+  for (int64_t j = 0; j < n; ++j)
+    if (parent[j] != -1) size[parent[j]] += size[j];
+  int64_t ns = 0, j = 0;
+  while (j < n) {
+    // maximal relaxed subtree containing j (postorder contiguity)
+    int64_t r = j;
+    while (parent[r] != -1 && size[parent[r]] <= relax) r = parent[r];
+    bool snode_root = size[r] <= relax &&
+                      (parent[r] == -1 || size[parent[r]] > relax);
+    if (snode_root) {
+      int64_t first = r - size[r] + 1;
+      int64_t w = r - first + 1;
+      int64_t start = first;
+      while (w > 0) {                 // split over-wide relaxed snodes
+        int64_t take = std::min(w, max_super);
+        xsup[ns] = start;
+        for (int64_t t = start; t < start + take; ++t) supno[t] = ns;
+        ++ns;
+        start += take;
+        w -= take;
+      }
+      j = r + 1;
+      continue;
+    }
+    // fundamental run starting at j (the snode_root clause of the
+    // oracle's loop condition is implied by size[k] > relax)
+    xsup[ns] = j;
+    supno[j] = ns;
+    int64_t k = j + 1;
+    while (k < n && parent[k - 1] == k &&
+           colcount[k - 1] == colcount[k] + 1 &&
+           (k - j) < max_super && size[k] > relax) {
+      supno[k] = ns;
+      ++k;
+    }
+    ++ns;
+    j = k;
+  }
+  xsup[ns] = n;
+  for (int64_t s = 0; s < ns; ++s) {
+    int64_t p = parent[xsup[s + 1] - 1];
+    sparent[s] = (p == -1) ? -1 : supno[p];
+  }
+  return ns;
+}
+
 // ------------------------------------------- nested dissection ordering
 // BFS level-set bisection nested dissection, the METIS_AT_PLUS_A /
 // ParMETIS slot of get_perm_c_dist (reference SRC/get_perm_c.c:91,489;
@@ -764,6 +823,6 @@ void slu_symbfact_free(void* handle) {
   delete static_cast<SymbHandle*>(handle);
 }
 
-int64_t slu_version() { return 3; }
+int64_t slu_version() { return 4; }
 
 }  // extern "C"

@@ -43,7 +43,27 @@ def find_supernodes(parent: np.ndarray, colcount: np.ndarray,
     2. Remaining columns: fundamental supernodes — j joins j-1 when
        parent(j-1) = j and colcount(j-1) = colcount(j)+1 — capped at
        `max_super`.
-    """
+
+    Dispatches to the native pass (csrc/slu_host.cpp slu_supernodes,
+    bit-identical); this Python loop is the fallback and oracle."""
+    n = len(parent)
+    if n:
+        from ..utils.native import native_or_none
+        native = native_or_none()
+        if native is not None:
+            ns, xsup, supno, sparent = native.supernodes(
+                np.ascontiguousarray(parent, dtype=np.int64),
+                np.ascontiguousarray(colcount, dtype=np.int64),
+                relax, max_super)
+            return SupernodePartition(
+                ns, xsup, supno, sparent,
+                tree_levels_from_leaves(sparent))
+    return find_supernodes_py(parent, colcount, relax, max_super)
+
+
+def find_supernodes_py(parent: np.ndarray, colcount: np.ndarray,
+                       relax: int, max_super: int) -> SupernodePartition:
+    """Pure-Python fallback / oracle for find_supernodes."""
     n = len(parent)
     if n == 0:
         return SupernodePartition(0, np.zeros(1, dtype=np.int64),

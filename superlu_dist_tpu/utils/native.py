@@ -103,8 +103,13 @@ def _load():
                                         ctypes.c_int64, ctypes.c_int64,
                                         _I64]
             lib.slu_ndorder.restype = ctypes.c_int64
+            lib.slu_supernodes.argtypes = [ctypes.c_int64, _I64, _I64,
+                                           ctypes.c_int64,
+                                           ctypes.c_int64, _I64, _I64,
+                                           _I64]
+            lib.slu_supernodes.restype = ctypes.c_int64
             lib.slu_version.restype = ctypes.c_int64
-            assert lib.slu_version() == 3
+            assert lib.slu_version() == 4
             _lib = lib
         except (OSError, AssertionError, AttributeError):
             _failed = True
@@ -136,8 +141,8 @@ def _cf64(a: np.ndarray):
 
 def etree(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
     lib = _load()
-    _, pp = _c64(indptr)
-    _, pi = _c64(indices)
+    a_pp, pp = _c64(indptr)
+    a_pi, pi = _c64(indices)
     parent = np.empty(n, dtype=np.int64)
     lib.slu_etree(n, pp, pi, parent.ctypes.data_as(_I64))
     return parent
@@ -146,7 +151,7 @@ def etree(indptr: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
 def postorder(parent: np.ndarray) -> np.ndarray:
     lib = _load()
     n = len(parent)
-    _, pp = _c64(parent)
+    a_pp, pp = _c64(parent)
     post = np.empty(n, dtype=np.int64)
     lib.slu_postorder(n, pp, post.ctypes.data_as(_I64))
     return post
@@ -156,9 +161,9 @@ def col_counts(indptr: np.ndarray, indices: np.ndarray,
                parent: np.ndarray) -> np.ndarray:
     lib = _load()
     n = len(parent)
-    _, pp = _c64(indptr)
-    _, pi = _c64(indices)
-    _, pa = _c64(parent)
+    a_pp, pp = _c64(indptr)
+    a_pi, pi = _c64(indices)
+    a_pa, pa = _c64(parent)
     cc = np.empty(n, dtype=np.int64)
     lib.slu_colcounts(n, pp, pi, pa, cc.ctypes.data_as(_I64))
     return cc
@@ -168,8 +173,8 @@ def amd_order(indptr: np.ndarray, indices: np.ndarray,
               n: int) -> np.ndarray:
     """Minimum-degree ordering; returns order[k] = k-th pivot."""
     lib = _load()
-    _, pp = _c64(indptr)
-    _, pi = _c64(indices)
+    a_pp, pp = _c64(indptr)
+    a_pi, pi = _c64(indices)
     order = np.empty(n, dtype=np.int64)
     got = lib.slu_mdorder(n, pp, pi, order.ctypes.data_as(_I64))
     if got != n:
@@ -183,9 +188,9 @@ def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
     rowperm[i] = destination position of row i and (u, v) are the dual
     potentials (R_i = exp(u_i), C_j = exp(v_j)/cmax_j scalings)."""
     lib = _load()
-    _, pc = _c64(colptr)
-    _, pr = _c64(rowind)
-    _, pv = _cf64(absval)
+    a_pc, pc = _c64(colptr)
+    a_pr, pr = _c64(rowind)
+    a_pv, pv = _cf64(absval)
     perm = np.empty(n, dtype=np.int64)
     u = np.empty(n, dtype=np.float64)
     v = np.empty(n, dtype=np.float64)
@@ -202,14 +207,32 @@ def nd_order(indptr: np.ndarray, indices: np.ndarray, n: int,
     Identical output to plan/nested.nd_order (the oracle); threads > 1
     fans the recursion halves over std::thread."""
     lib = _load()
-    _, pp = _c64(indptr)
-    _, pi = _c64(indices)
+    a_pp, pp = _c64(indptr)
+    a_pi, pi = _c64(indices)
     out = np.empty(n, dtype=np.int64)
     got = lib.slu_ndorder(n, pp, pi, leaf_size, threads,
                           out.ctypes.data_as(_I64))
     if got != n:
         raise RuntimeError(f"native ndorder returned {got} of {n}")
     return out
+
+
+def supernodes(parent: np.ndarray, colcount: np.ndarray, relax: int,
+               max_super: int):
+    """Supernode partition; returns (nsuper, xsup, supno, sparent) —
+    bit-identical to plan/supernodes.find_supernodes (the oracle)."""
+    lib = _load()
+    n = len(parent)
+    a_pp, pp = _c64(parent)
+    a_pc, pc = _c64(colcount)
+    supno = np.empty(n, dtype=np.int64)
+    xsup = np.empty(n + 1, dtype=np.int64)
+    sparent = np.empty(n if n else 1, dtype=np.int64)
+    ns = int(lib.slu_supernodes(n, pp, pc, relax, max_super,
+                                supno.ctypes.data_as(_I64),
+                                xsup.ctypes.data_as(_I64),
+                                sparent.ctypes.data_as(_I64)))
+    return ns, xsup[:ns + 1].copy(), supno, sparent[:ns].copy()
 
 
 def symbfact(n: int, b_indptr: np.ndarray, b_indices: np.ndarray,
@@ -219,10 +242,10 @@ def symbfact(n: int, b_indptr: np.ndarray, b_indices: np.ndarray,
     per-supernode sorted off-block row index arrays.  threads > 1
     runs the level-parallel variant (identical output)."""
     lib = _load()
-    _, pp = _c64(b_indptr)
-    _, pi = _c64(b_indices)
-    _, px = _c64(xsup)
-    _, ps = _c64(sparent)
+    a_pp, pp = _c64(b_indptr)
+    a_pi, pi = _c64(b_indices)
+    a_px, px = _c64(xsup)
+    a_ps, ps = _c64(sparent)
     if threads > 1:
         h = lib.slu_symbfact_create_par(n, pp, pi, nsuper, px, ps,
                                         threads)

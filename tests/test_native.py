@@ -151,6 +151,35 @@ def test_symbfact_matches_python():
             np.testing.assert_array_equal(struct_c[s], struct_p[s])
 
 
+def test_supernodes_match_python_oracle():
+    """Native slu_supernodes must be bit-identical to the Python
+    find_supernodes (relaxed subtrees, over-wide splits, fundamental
+    runs, sparent derivation)."""
+    from superlu_dist_tpu.plan.supernodes import (find_supernodes,
+                                                  find_supernodes_py)
+    from superlu_dist_tpu.plan.etree import col_counts_postordered
+    rng = np.random.default_rng(9)
+    for n in (30, 120, 400):
+        _, b = _random_pattern(rng, n)
+        ip = b.indptr.astype(np.int64)
+        ix = b.indices.astype(np.int64)
+        parent = etree_symmetric_py(ip, ix, n)
+        post = postorder_py(parent)
+        bp = b[post][:, post].tocsr()
+        bp.sort_indices()
+        par2 = relabel_tree(parent, post)
+        cc = col_counts_postordered(bp.indptr.astype(np.int64),
+                                    bp.indices.astype(np.int64), par2)
+        for relax, msup in ((1, 4), (4, 16), (32, 128)):
+            p1 = find_supernodes_py(par2, cc, relax, msup)
+            p2 = find_supernodes(par2, cc, relax, msup)
+            assert p1.nsuper == p2.nsuper
+            np.testing.assert_array_equal(p1.xsup, p2.xsup)
+            np.testing.assert_array_equal(p1.supno, p2.supno)
+            np.testing.assert_array_equal(p1.sparent, p2.sparent)
+            np.testing.assert_array_equal(p1.levels, p2.levels)
+
+
 def test_ndorder_matches_python_oracle():
     """Native nested dissection must be BIT-IDENTICAL to the numpy
     implementation (same BFS level sets, same pseudo-peripheral
