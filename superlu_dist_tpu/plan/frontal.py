@@ -86,18 +86,6 @@ class FrontalPlan:
         return self.sym.nsuper
 
 
-def _local_positions(I_s: np.ndarray, first: int, last: int,
-                     struct_s: np.ndarray, idx: np.ndarray) -> np.ndarray:
-    """Position of each global index in I_s = [first..last] ∪ struct_s."""
-    w = last - first + 1
-    inblock = idx <= last
-    pos = np.empty(len(idx), dtype=np.int64)
-    pos[inblock] = idx[inblock] - first
-    if np.any(~inblock):
-        pos[~inblock] = w + np.searchsorted(struct_s, idx[~inblock])
-    return pos
-
-
 def build_frontal_plan(sym: SymbolicFactorization,
                        coo_rows: np.ndarray, coo_cols: np.ndarray,
                        width_buckets: tuple, front_buckets: tuple,
@@ -119,34 +107,53 @@ def build_frontal_plan(sym: SymbolicFactorization,
     I = [np.concatenate([np.arange(xsup[s], xsup[s + 1]), sym.struct[s]])
          for s in range(ns)]
 
+    # One keyed searchsorted resolves EVERY (supernode, global index)
+    # -> front position query at once: struct entries of supernode s
+    # live at key s·(n+1)+index in one sorted concatenation, so a
+    # query batch of mixed supernodes is a single O(Q·log) pass.
+    soff = np.concatenate(([0], np.cumsum(r)))
+    struct_cat = (np.concatenate(sym.struct) if ns
+                  else np.empty(0, dtype=np.int64))
+    KEY = np.int64(n + 1)
+    skeys = np.repeat(np.arange(ns, dtype=np.int64), r) * KEY + struct_cat
+
+    def positions(sup_of_q: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        last_of = xsup[sup_of_q + 1] - 1
+        inb = idx <= last_of
+        pos = np.empty(len(idx), dtype=np.int64)
+        pos[inb] = idx[inb] - xsup[sup_of_q[inb]]
+        q = ~inb
+        if np.any(q):
+            j = np.searchsorted(skeys, sup_of_q[q] * KEY + idx[q])
+            pos[q] = w[sup_of_q[q]] + (j - soff[sup_of_q[q]])
+        return pos
+
     # --- A-entry ownership: supernode of min(i,j) ---
     k = np.minimum(coo_rows, coo_cols)
     owner = part.supno[k]
     order = np.argsort(owner, kind="stable")
     bounds = np.searchsorted(owner[order], np.arange(ns + 1))
-    a_src: List[np.ndarray] = []
-    a_lr: List[np.ndarray] = []
-    a_lc: List[np.ndarray] = []
-    for s in range(ns):
-        sel = order[bounds[s]:bounds[s + 1]]
-        first, last = int(xsup[s]), int(xsup[s + 1] - 1)
-        lr = _local_positions(I[s], first, last, sym.struct[s], coo_rows[sel])
-        lc = _local_positions(I[s], first, last, sym.struct[s], coo_cols[sel])
-        a_src.append(sel)
-        a_lr.append(lr)
-        a_lc.append(lc)
+    own_sorted = owner[order]
+    lr_all = positions(own_sorted, coo_rows[order])
+    lc_all = positions(own_sorted, coo_cols[order])
+    a_src = [order[bounds[s]:bounds[s + 1]] for s in range(ns)]
+    a_lr = [lr_all[bounds[s]:bounds[s + 1]] for s in range(ns)]
+    a_lc = [lc_all[bounds[s]:bounds[s + 1]] for s in range(ns)]
 
-    # --- extend-add maps ---
+    # --- extend-add maps: positions of struct(s) inside parent front ---
+    has_ea = (part.sparent >= 0) & (r > 0)
+    ea_sup = np.repeat(part.sparent[has_ea], r[has_ea])
+    ea_idx = struct_cat[np.repeat(has_ea, r)]
+    ea_all = positions(ea_sup, ea_idx)
+    ea_bounds = np.concatenate(([0], np.cumsum(r[has_ea])))
     ea_map: List[np.ndarray] = []
+    ei = 0
     for s in range(ns):
-        p = part.sparent[s]
-        if p == -1 or r[s] == 0:
+        if has_ea[s]:
+            ea_map.append(ea_all[ea_bounds[ei]:ea_bounds[ei + 1]])
+            ei += 1
+        else:
             ea_map.append(np.empty(0, dtype=np.int64))
-            continue
-        firstp, lastp = int(xsup[p]), int(xsup[p + 1] - 1)
-        pos = _local_positions(I[p], firstp, lastp, sym.struct[p],
-                               sym.struct[s])
-        ea_map.append(pos)
 
     # --- level schedule ---
     nlev = int(part.levels.max()) + 1 if ns else 0
