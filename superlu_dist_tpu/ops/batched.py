@@ -101,6 +101,11 @@ class GroupSpec:
     U_off: int
     Li_off: int
     Ui_off: int
+    # False when every front's parent lives on the same device (zone-
+    # affine placement): the update slab then skips its all_gather and
+    # each device writes only its local slice — the gather-free
+    # subforest interior of the 3D algorithm (SRC/pdgstrf3d.c:292)
+    needs_gather: bool = True
     _dev: Optional[dict] = None  # lazy device-array cache, keyed by squeeze
 
     def dev(self, squeeze: bool):
@@ -141,6 +146,55 @@ class BatchedSchedule:
     U_total: int
     Li_total: int
     Ui_total: int
+    sup_dev: np.ndarray = None  # front -> device placement
+
+
+def _zone_assignment(fp, ndev: int) -> np.ndarray:
+    """Subtree-affine device zones — the greedy load-balanced forest
+    partition of the 3D algorithm (getGreedyLoadBalForests,
+    SRC/supernodalForest.c:794): split the supernodal etree into
+    ≥ 4·ndev maximal subtrees, bin-pack them onto devices by subtree
+    flops, leave the shared ancestors above the cut at zone −1.
+    Fronts inside a zone extend-add only device-locally, so their
+    groups skip the update-slab all_gather."""
+    from ..plan.etree import subtree_sizes
+    from ..plan.frontal import front_flops
+    ns = fp.nsuper
+    zone = np.full(ns, -1, dtype=np.int64)
+    if ns == 0:
+        return zone
+    if ndev <= 1:
+        zone[:] = 0
+        return zone
+    sparent = fp.sym.part.sparent
+    ft = front_flops(fp.w, fp.r)
+    size = subtree_sizes(sparent)
+    for s in range(ns):           # ascending = children before parents
+        p = sparent[s]
+        if p >= 0:
+            ft[p] += ft[s]
+    import heapq
+    heap = [(-float(ft[s]), int(s))
+            for s in np.flatnonzero(sparent == -1)]
+    heapq.heapify(heap)
+    fixed: list = []
+    children = fp.sym.children
+    while heap and len(heap) + len(fixed) < 4 * ndev:
+        _, s = heapq.heappop(heap)
+        ch = children[s]
+        if len(ch) == 0:
+            fixed.append(s)       # indivisible leaf subtree
+        else:
+            for c in ch:          # s itself becomes a shared ancestor
+                heapq.heappush(heap, (-float(ft[c]), int(c)))
+    cands = fixed + [s for _, s in heap]
+    loads = np.zeros(ndev)
+    for s in sorted(cands, key=lambda t: -ft[t]):
+        d = int(np.argmin(loads))
+        loads[d] += ft[s]
+        # postorder contiguity: subtree of s = [s - size + 1, s]
+        zone[s - size[s] + 1:s + 1] = d
+    return zone
 
 
 def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
@@ -149,6 +203,9 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     xsup = part.xsup
     n = plan.n
     nnz = len(plan.coo_rows)
+    zone = _zone_assignment(fp, ndev)
+    sparent = part.sparent
+    sup_dev = np.zeros(fp.nsuper, dtype=np.int64)
 
     sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
     groups: List[GroupSpec] = []
@@ -208,10 +265,35 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                                  []).append(int(s))
         for (wb, mb), slist in sorted(by_bucket.items()):
             N = len(slist)
-            # pad per-device count to the {2^k, 1.5·2^k} grid
-            n_loc = _next_bucket(-(-N // ndev))
-            n_tot = n_loc * ndev
             rb = mb - wb
+
+            # zone-affine placement: fronts stick to their subtree's
+            # device so interior extend-adds stay device-local; shared
+            # ancestors (zone −1) go to the least-loaded device.  A
+            # 2× padding guard falls back to round-robin (which then
+            # forces the gather) when zones are too skewed here.
+            per_dev_s: List[list] = [[] for _ in range(ndev)]
+            shared = []
+            for s in slist:
+                z = zone[s]
+                if 0 <= z < ndev:
+                    per_dev_s[z].append(s)
+                else:
+                    shared.append(s)
+            for s in shared:
+                d = min(range(ndev), key=lambda t: len(per_dev_s[t]))
+                per_dev_s[d].append(s)
+            maxc = max(len(v) for v in per_dev_s)
+            if maxc > 2 * (-(-N // ndev)):
+                # skewed zones would blow padding; round-robin instead
+                # (needs_gather is settled exactly in the post-pass
+                # below, from ACTUAL placements)
+                per_dev_s = [list(slist[d::ndev]) for d in range(ndev)]
+                maxc = max(len(v) for v in per_dev_s)
+
+            # pad per-device count to the {2^k, 1.5·2^k} grid
+            n_loc = _next_bucket(maxc)
+            n_tot = n_loc * ndev
             f_loc = n_loc * mb * mb
 
             # consume child slabs (each front is extend-added exactly
@@ -234,8 +316,9 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             col_idx = np.full((ndev, n_loc, wb), n, dtype=np.int64)
             struct_idx = np.full((ndev, n_loc, rb), n, dtype=np.int64)
 
-            for bg, s in enumerate(slist):
-                d, b = divmod(bg, n_loc)
+            for d, b, s in ((d, b, s) for d in range(ndev)
+                            for b, s in enumerate(per_dev_s[d])):
+                bg = d * n_loc + b
                 w = int(fp.w[s]); r = int(fp.r[s])
                 base = b * mb * mb
                 lr = _pad_pos(fp.a_lr[s], w, wb)
@@ -264,12 +347,13 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 # global update slab is device-major contiguous so an
                 # all_gather of local slabs reproduces it exactly
                 sup_upd_off[s] = upd_off + bg * rb * rb
+                sup_dev[s] = d
             # dummy fronts (including wholly idle devices): identity
             # pivot block so the padded LU is well-defined
-            for bg in range(N, n_tot):
-                d, b = divmod(bg, n_loc)
-                t = np.arange(wb)
-                per_dev["one"][d].append(b * mb * mb + t * mb + t)
+            for d in range(ndev):
+                for b in range(len(per_dev_s[d]), n_loc):
+                    t = np.arange(wb)
+                    per_dev["one"][d].append(b * mb * mb + t * mb + t)
 
             def stack(key, fill, distinct_pad=False):
                 """distinct_pad gives every padding slot its own
@@ -320,10 +404,22 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     for g in groups:
         g.ea_src[g.ea_src == -1] = upd_peak
 
+    # gather post-pass, from ACTUAL placements (parents are always
+    # scheduled after their children, so sup_dev is complete here): a
+    # group's slab may skip its all_gather exactly when every consumer
+    # of every front in it lives on the producing device.  Zones only
+    # GUIDE placement; this decision never assumes they were honored.
+    for g in groups:
+        g.needs_gather = ndev > 1 and any(
+            fp.r[int(s)] > 0
+            and sup_dev[int(sparent[int(s)])] != sup_dev[int(s)]
+            for s in g.sup_ids)
+
     return BatchedSchedule(groups=groups, ndev=ndev, n=n,
                            upd_total=upd_peak,
                            L_total=L_cur, U_total=U_cur,
-                           Li_total=Li_cur, Ui_total=Ui_cur)
+                           Li_total=Li_cur, Ui_total=Ui_cur,
+                           sup_dev=sup_dev)
 
 
 def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
@@ -374,11 +470,23 @@ def _hi_prec(fn):
     return wrapped
 
 
+def _flat_axis_index(axis):
+    """Row-major flattened index over a (possibly tuple) mesh axis —
+    matches all_gather's tiled concatenation order."""
+    if isinstance(axis, tuple):
+        idx = jnp.zeros((), jnp.int32)
+        for a in axis:
+            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        return idx
+    return jax.lax.axis_index(axis)
+
+
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        tiny, nzero, thresh, a_src, a_dst, one_dst,
                        ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
                        Ui_off, *, mb: int, wb: int, n_pad: int,
-                       axis: Optional[str] = None):
+                       axis: Optional[str] = None,
+                       gather: bool = True):
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     F = jnp.zeros(n_pad * mb * mb, dtype)
@@ -410,13 +518,23 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                                            (Ui_off,))
     if mb > wb:
         upd = F[:, wb:, wb:].reshape(-1)
-        if axis is not None:
+        if axis is not None and gather:
             # ancestor propagation: the reference's dreduceAncestors3d /
             # Z-axis panel exchange becomes one tiled all_gather along
             # the mesh axis — device-major local slabs concatenate into
             # exactly the global slab layout
             upd = jax.lax.all_gather(upd, axis, tiled=True)
-        upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd, (upd_off,))
+            off = upd_off
+        elif axis is not None:
+            # gather-free subforest interior (zone-affine placement):
+            # every consumer of this slab lives on this device, so
+            # each device writes only its own device-major slice and
+            # no ICI traffic happens (dsparseTreeFactor's layer-local
+            # phase, SRC/pdgstrf3d.c:292-322)
+            off = upd_off + _flat_axis_index(axis) * upd.size
+        else:
+            off = upd_off
+        upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd, (off,))
     return (upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
             tiny + tiny_g, nzero + nzero_g)
 

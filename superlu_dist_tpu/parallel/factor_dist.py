@@ -91,7 +91,7 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
             jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
             jnp.int32(g.U_off), jnp.int32(g.Li_off),
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-            axis=axis)
+            axis=axis, gather=g.needs_gather)
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
 
 

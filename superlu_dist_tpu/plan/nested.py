@@ -7,10 +7,10 @@ level structure at the median, middle level set is the separator,
 separator ordered last.  Each recursion step extracts the induced
 subgraph with *local* labels, so per-block work is O(nnz_block) and the
 whole ordering is O(nnz·log n).  Near-optimal on mesh-like graphs
-(which is what the solver's headline benchmarks factor).  Also the
-source of the separator tree that seeds the 3D forest partition
-(parallel/forest.py), the way ParMETIS separator sizes seed
-symbfact_dist in the reference.
+(which is what the solver's headline benchmarks factor).  The etree
+this ordering induces also seeds the subtree-affine device zones of
+the distributed schedule (ops/batched.py _zone_assignment), the way
+ParMETIS separator sizes seed symbfact_dist in the reference.
 """
 
 from __future__ import annotations

@@ -101,6 +101,32 @@ def test_grid_factory():
         make_solver_mesh(4, 4, 4)
 
 
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_gather_free_groups_safe(ndev):
+    """Safety invariant of the zone-affine placement: a group may
+    skip its update-slab all_gather ONLY when every front's parent is
+    placed on the producing device (checked against the ACTUAL
+    placements, not the zone guidance).  Also require that realistic
+    ND-ordered problems actually get some gather-free interior."""
+    from superlu_dist_tpu.ops.batched import get_schedule
+    a = laplacian_2d(48)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    sched = get_schedule(plan, ndev)
+    fp = plan.frontal
+    sparent = fp.sym.part.sparent
+    dev = sched.sup_dev
+    for g in sched.groups:
+        if g.needs_gather:
+            continue
+        for s in g.sup_ids:
+            s = int(s)
+            if fp.r[s] > 0:
+                assert dev[sparent[s]] == dev[s], (
+                    "gather-free group has a cross-device consumer")
+    assert any(not g.needs_gather and g.mb > g.wb
+               for g in sched.groups), "no gather-free interior found"
+
+
 def test_gridinit_multihost_single_process():
     """Single-process degenerate case of the multi-host initializer:
     same mesh as make_solver_mesh, no distributed runtime started."""
