@@ -257,8 +257,8 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
     # single-device case is ndev=1 of the same layout
     U_total = U_flat.size // sched.ndev
     for g in sched.groups:
-        for bg, s in enumerate(g.sup_ids):
-            d, b = divmod(bg, g.n_loc)
+        for bg, s in zip(g.sup_pos, g.sup_ids):
+            d, b = divmod(int(bg), g.n_loc)
             base = d * U_total + g.U_off + b * g.wb * g.mb
             panel = U_flat[base:base + g.wb * g.mb].reshape(g.wb, g.mb)
             w = int(fp.w[s])

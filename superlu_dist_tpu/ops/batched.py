@@ -89,6 +89,9 @@ class GroupSpec:
     n_loc: int                 # fronts per device (padded)
     n_true: int                # true front count across devices
     sup_ids: np.ndarray
+    sup_pos: np.ndarray        # linear slot d*n_loc+b per sup_ids entry
+                               # (zone placement reorders fronts, so
+                               # position in sup_ids ≠ slot)
     a_src: np.ndarray          # (ndev, La) into vals (+ zero slot)
     a_dst: np.ndarray          # (ndev, La) local-front linear indices
     one_dst: np.ndarray        # (ndev, Lo)
@@ -310,44 +313,47 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                             _free(gc)
             upd_off = _alloc(n_tot * rb * rb)
 
+            sup_pos = np.empty(len(slist), dtype=np.int64)
+            pos_of = {s: i for i, s in enumerate(slist)}
             per_dev = {k: [[] for _ in range(ndev)]
                        for k in ("a_src", "a_dst", "one", "ea_src",
                                  "ea_dst")}
             col_idx = np.full((ndev, n_loc, wb), n, dtype=np.int64)
             struct_idx = np.full((ndev, n_loc, rb), n, dtype=np.int64)
 
-            for d, b, s in ((d, b, s) for d in range(ndev)
-                            for b, s in enumerate(per_dev_s[d])):
-                bg = d * n_loc + b
-                w = int(fp.w[s]); r = int(fp.r[s])
-                base = b * mb * mb
-                lr = _pad_pos(fp.a_lr[s], w, wb)
-                lc = _pad_pos(fp.a_lc[s], w, wb)
-                per_dev["a_src"][d].append(fp.a_src[s])
-                per_dev["a_dst"][d].append(base + lr * mb + lc)
-                if wb > w:
-                    t = np.arange(w, wb)
-                    per_dev["one"][d].append(base + t * mb + t)
-                for c in fp.sym.children[s]:
-                    rc = int(fp.r[c])
-                    if rc == 0:
-                        continue
-                    rbc = int(fp.mb[c]) - int(fp.wb[c])
-                    coff = sup_upd_off[c]
-                    assert coff >= 0, "child scheduled after parent"
-                    ar = np.arange(rc)
-                    per_dev["ea_src"][d].append(
-                        (coff + ar[:, None] * rbc + ar[None, :]).ravel())
-                    pos = _pad_pos(fp.ea_map[c], w, wb)
-                    per_dev["ea_dst"][d].append(
-                        (base + pos[:, None] * mb
-                         + pos[None, :]).ravel())
-                col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
-                struct_idx[d, b, :r] = fp.sym.struct[s]
-                # global update slab is device-major contiguous so an
-                # all_gather of local slabs reproduces it exactly
-                sup_upd_off[s] = upd_off + bg * rb * rb
-                sup_dev[s] = d
+            for d in range(ndev):
+                for b, s in enumerate(per_dev_s[d]):
+                    bg = d * n_loc + b
+                    w = int(fp.w[s]); r = int(fp.r[s])
+                    base = b * mb * mb
+                    lr = _pad_pos(fp.a_lr[s], w, wb)
+                    lc = _pad_pos(fp.a_lc[s], w, wb)
+                    per_dev["a_src"][d].append(fp.a_src[s])
+                    per_dev["a_dst"][d].append(base + lr * mb + lc)
+                    if wb > w:
+                        t = np.arange(w, wb)
+                        per_dev["one"][d].append(base + t * mb + t)
+                    for c in fp.sym.children[s]:
+                        rc = int(fp.r[c])
+                        if rc == 0:
+                            continue
+                        rbc = int(fp.mb[c]) - int(fp.wb[c])
+                        coff = sup_upd_off[c]
+                        assert coff >= 0, "child scheduled after parent"
+                        ar = np.arange(rc)
+                        per_dev["ea_src"][d].append(
+                            (coff + ar[:, None] * rbc + ar[None, :]).ravel())
+                        pos = _pad_pos(fp.ea_map[c], w, wb)
+                        per_dev["ea_dst"][d].append(
+                            (base + pos[:, None] * mb
+                             + pos[None, :]).ravel())
+                    col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
+                    struct_idx[d, b, :r] = fp.sym.struct[s]
+                    # global update slab is device-major contiguous so an
+                    # all_gather of local slabs reproduces it exactly
+                    sup_upd_off[s] = upd_off + bg * rb * rb
+                    sup_dev[s] = d
+                    sup_pos[pos_of[s]] = bg
             # dummy fronts (including wholly idle devices): identity
             # pivot block so the padded LU is well-defined
             for d in range(ndev):
@@ -379,6 +385,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             groups.append(GroupSpec(
                 level=lv, mb=mb, wb=wb, n_loc=n_loc, n_true=N,
                 sup_ids=np.asarray(slist, dtype=np.int64),
+                sup_pos=sup_pos,
                 a_src=stack("a_src", nnz),
                 a_dst=stack("a_dst", f_loc, distinct_pad=True),
                 one_dst=stack("one", f_loc, distinct_pad=True),
@@ -473,11 +480,6 @@ def _hi_prec(fn):
 def _flat_axis_index(axis):
     """Row-major flattened index over a (possibly tuple) mesh axis —
     matches all_gather's tiled concatenation order."""
-    if isinstance(axis, tuple):
-        idx = jnp.zeros((), jnp.int32)
-        for a in axis:
-            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
-        return idx
     return jax.lax.axis_index(axis)
 
 
