@@ -26,6 +26,69 @@ import jax
 import jax.numpy as jnp
 
 
+def _newton_tri_inverse(T, *, lower: bool, unit: bool):
+    """inv(T) for batched (…, k, k) triangular T via Newton iteration
+    X ← X(2I − TX).  For triangular T the error I − TX is nilpotent
+    (strictly triangular after the diagonal seed), so the iteration is
+    EXACT after ⌈log2 k⌉ steps — and every step is an MXU matmul,
+    unlike lax.linalg.triangular_solve which TPU lowers to a
+    sequential column sweep."""
+    k = T.shape[-1]
+    dtype = T.dtype
+    eye = jnp.eye(k, dtype=dtype)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (k, k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (k, k), 1)
+    keep = rows > cols if lower else rows < cols
+    N = jnp.where(keep, T, 0)                   # strict part
+    if unit:
+        X = eye - N                             # exact for k ≤ 2
+        A = eye + N
+    else:
+        d = jnp.expand_dims(
+            jnp.diagonal(T, axis1=-2, axis2=-1), -1)  # (..., k, 1)
+        # T = D(I + D⁻¹N) [lower: row scaling]  or (I + ND⁻¹)D [upper]
+        # handled uniformly by scaling N's rows by 1/d for lower and
+        # N's rows by 1/d for upper too (N strictly upper: row i of
+        # D⁻¹T has N[i,:]/d[i]) — both cases are D⁻¹T = I + D⁻¹N.
+        Nn = N / d
+        X = eye - Nn
+        A = eye + Nn
+    steps = max(0, (k - 1).bit_length() - 1)
+    for _ in range(steps):
+        X = X @ (2 * eye - A @ X)
+    if not unit:
+        X = X / jnp.swapaxes(d, -1, -2)         # inv = inv(I+D⁻¹N)·D⁻¹
+    return X
+
+
+def _blocked_tri_inverse(T, *, lower: bool, unit: bool, base: int = 64):
+    """inv(T) for batched (…, k, k) triangular T by 2×2 block
+    recursion:  inv([[A,0],[C,B]]) = [[Ai,0],[−Bi·C·Ai,Bi]] (lower)
+    and the transposed identity for upper.  O(log k) recursion depth,
+    all large MXU matmuls; leaves use the exact Newton inverse."""
+    k = T.shape[-1]
+    if k <= base:
+        return _newton_tri_inverse(T, lower=lower, unit=unit)
+    h = k // 2
+    A = T[..., :h, :h]
+    B = T[..., h:, h:]
+    Ai = _blocked_tri_inverse(A, lower=lower, unit=unit, base=base)
+    Bi = _blocked_tri_inverse(B, lower=lower, unit=unit, base=base)
+    if lower:
+        C = T[..., h:, :h]
+        off = -(Bi @ C @ Ai)
+        top = jnp.concatenate([Ai, jnp.zeros_like(C.swapaxes(-1, -2))],
+                              axis=-1)
+        bot = jnp.concatenate([off, Bi], axis=-1)
+    else:
+        C = T[..., :h, h:]
+        off = -(Ai @ C @ Bi)
+        top = jnp.concatenate([Ai, off], axis=-1)
+        bot = jnp.concatenate([jnp.zeros_like(C.swapaxes(-1, -2)), Bi],
+                              axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
 def _tiny_replace(piv, thresh, dtype):
     """GESP tiny-pivot replacement: |piv| < thresh → sign(piv)·thresh
     (SRC/pdgstrf2.c; counted into stat->TinyPivots).  Also flags an
@@ -64,49 +127,46 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
     nb = min(nb, wb)
     assert wb % nb == 0, "width buckets must be multiples of the block"
     rows = jnp.arange(mb)
-    rows_nb = jnp.arange(nb)
 
-    def d_step(t, carry):
-        """Eliminate column t of the (nb, nb) diagonal block."""
-        D, tiny, nzero = carry
-        piv = jax.lax.dynamic_index_in_dim(
-            jax.lax.dynamic_index_in_dim(D, t, axis=0, keepdims=False),
-            t, axis=0, keepdims=False)
-        piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
-        col = jax.lax.dynamic_index_in_dim(D, t, axis=1, keepdims=False)
-        below = rows_nb > t
-        scaled = jnp.where(below, col / piv, col)
-        scaled = jnp.where(rows_nb == t, piv, scaled)
-        D = jax.lax.dynamic_update_index_in_dim(D, scaled, t, axis=1)
-        rowvec = jax.lax.dynamic_index_in_dim(D, t, axis=0,
-                                              keepdims=False)
-        upd = jnp.outer(jnp.where(below, scaled, 0),
-                        jnp.where(rows_nb > t, rowvec, 0))
-        D = D - upd
-        return D, tiny + was_tiny, nzero + was_zero
+    def _factor_diag(D, tiny, nzero):
+        """Right-looking elimination of the (nb, nb) diagonal block,
+        statically unrolled: every index is a Python int, so the whole
+        nb-column chain is ONE fused loop-body instead of nb sequential
+        fori_loop dispatches (the scalar critical path of LU is
+        unavoidable; paying per-iteration dispatch latency for it is
+        not)."""
+        for t in range(nb):
+            piv, was_tiny, was_zero = _tiny_replace(D[t, t], thresh,
+                                                    dtype)
+            tiny = tiny + was_tiny
+            nzero = nzero + was_zero
+            ltail = D[t + 1:, t] / piv
+            utail = D[t, t + 1:]
+            D = D.at[t, t].set(piv)
+            D = D.at[t + 1:, t].set(ltail)
+            D = D.at[t + 1:, t + 1:].add(-jnp.outer(ltail, utail))
+        return D, tiny, nzero
 
     def block_step(kb, carry):
         F, tiny, nzero = carry
         k0 = kb * nb
         D = jax.lax.dynamic_slice(F, (k0, k0), (nb, nb))
-        D, tiny, nzero = jax.lax.fori_loop(0, nb, d_step,
-                                           (D, tiny, nzero))
+        D, tiny, nzero = _factor_diag(D, tiny, nzero)
         F = jax.lax.dynamic_update_slice(F, D, (k0, k0))
-        tri = jnp.where(rows_nb[:, None] > rows_nb[None, :], D, 0)
-        L11 = tri + jnp.eye(nb, dtype=dtype)
-        U11 = D - tri
+        # exact Newton triangular inverses of the nb×nb factors: MXU
+        # matmuls instead of triangular_solve's sequential column sweep
+        U11i = _newton_tri_inverse(D, lower=False, unit=False)
+        L11i = _newton_tri_inverse(D, lower=True, unit=True)
         # L21 = A21 · U11⁻¹ over the full column slice; keep rows ≥
         # k0+nb (rows < k0 hold finished U entries, D already written)
         colp = jax.lax.dynamic_slice(F, (0, k0), (mb, nb))
-        L21 = jax.lax.linalg.triangular_solve(
-            U11, colp, left_side=False, lower=False)
+        L21 = colp @ U11i
         keep_r = (rows >= k0 + nb)[:, None]
         colp2 = jnp.where(keep_r, L21, colp)
         F = jax.lax.dynamic_update_slice(F, colp2, (0, k0))
         # U12 = L11⁻¹ · A12 over the full row slice
         rowp = jax.lax.dynamic_slice(F, (k0, 0), (nb, mb))
-        U12 = jax.lax.linalg.triangular_solve(
-            L11, rowp, left_side=True, lower=True, unit_diagonal=True)
+        U12 = L11i @ rowp
         keep_c = (rows >= k0 + nb)[None, :]
         rowp2 = jnp.where(keep_c, U12, rowp)
         F = jax.lax.dynamic_update_slice(F, rowp2, (k0, 0))
@@ -137,14 +197,10 @@ def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
 def unit_lower_inverse(L):
     """inv(L) for batched unit-lower (N, w, w) — the DiagInv
     preparation (SRC/pdgssvx.c:1436-1447): turns the solve's TRSV into
-    GEMM."""
-    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
-    return jax.lax.linalg.triangular_solve(
-        L, eye, left_side=True, lower=True, unit_diagonal=True)
+    GEMM.  Blocked 2×2 recursion + exact Newton leaves, all MXU."""
+    return _blocked_tri_inverse(L, lower=True, unit=True)
 
 
 def upper_inverse(U):
     """inv(U) for batched upper-triangular (N, w, w)."""
-    eye = jnp.broadcast_to(jnp.eye(U.shape[-1], dtype=U.dtype), U.shape)
-    return jax.lax.linalg.triangular_solve(
-        U, eye, left_side=True, lower=False, unit_diagonal=False)
+    return _blocked_tri_inverse(U, lower=False, unit=False)

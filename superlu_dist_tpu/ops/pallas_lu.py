@@ -62,19 +62,11 @@ def _pick_nb(wb: int, nb_max: int = 32) -> int:
 
 
 def _unit_lower_inverse_newton(L, nb: int):
-    """inv(unit-lower L) via Newton iteration X ← X(2I − LX), exact
-    after ⌈log2(nb)⌉ steps because the error (I − LX) is strictly
-    lower (nilpotent): E_{k+1} = E_k².  All work is (nb × nb) MXU
-    matmuls — Mosaic has no triangular_solve."""
-    eye = jnp.eye(nb, dtype=L.dtype)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
-    Lu = jnp.where(rows > cols, L, 0) + eye    # unit diagonal, clear U
-    X = 2 * eye - Lu                           # I − N seed
-    steps = max(1, (nb - 1).bit_length())
-    for _ in range(steps - 1):
-        X = X @ (2 * eye - Lu @ X)
-    return X
+    """inv(unit-lower L), exact Newton iteration — delegates to the
+    shared dense_lu helper (plain jnp ops, Mosaic-compatible; Mosaic
+    has no triangular_solve)."""
+    from .dense_lu import _newton_tri_inverse
+    return _newton_tri_inverse(L, lower=True, unit=True)
 
 
 def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
