@@ -187,7 +187,8 @@ def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
     Returns (F', tiny_count, zero_pivot_count).  Dispatches to the
     VMEM-resident Pallas kernel when enabled (ops/pallas_lu.py)."""
     from . import pallas_lu
-    if pallas_lu.enabled(F.dtype):
+    if pallas_lu.enabled(F.dtype) and pallas_lu.usable(F.shape[-1],
+                                                      F.dtype):
         return pallas_lu.partial_lu_batch_pallas(F, thresh, wb=wb)
     f = functools.partial(partial_lu, wb=wb, nb=nb)
     Fs, tinys, nzeros = jax.vmap(lambda x: f(x, thresh))(F)

@@ -50,6 +50,16 @@ def enabled(dtype) -> bool:
     return flag == "1"
 
 
+# the kernel keeps input+output front copies VMEM-resident (~16 MB/core
+# on v5e); beyond this the XLA path takes over for that bucket
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def usable(mb: int, dtype) -> bool:
+    """Does one (mb × mb) front fit the kernel's VMEM working set?"""
+    return 2 * mb * mb * np.dtype(dtype).itemsize <= _VMEM_BUDGET_BYTES
+
+
 def _pick_nb(wb: int, nb_max: int = 32) -> int:
     """Largest panel block ≤ nb_max dividing wb (wb buckets live on
     the {2^k, 1.5·2^k} grid, so a divisor ≤ 32 always exists)."""
@@ -135,8 +145,8 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
                                              (k0 + nb, k0 + nb))
 
     out_ref[0] = F
-    tiny_ref[0] = tiny
-    nzero_ref[0] = nzero
+    tiny_ref[0, 0] = tiny
+    nzero_ref[0, 0] = nzero
 
 
 def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
@@ -174,8 +184,8 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
     zero = jnp.zeros((), jnp.int32)
     F, tiny, nzero = jax.lax.fori_loop(0, wb, col_step, (F, zero, zero))
     out_ref[0] = F
-    tiny_ref[0] = tiny
-    nzero_ref[0] = nzero
+    tiny_ref[0, 0] = tiny
+    nzero_ref[0, 0] = nzero
 
 
 def partial_lu_batch_pallas(F, thresh, *, wb: int,
@@ -193,6 +203,14 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
         kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
     else:
         kern = functools.partial(_lu_kernel_blocked, wb=wb, mb=mb)
+    # Mosaic's lowering visitors recurse through the unrolled block
+    # chain.  Under jit this call only binds the primitive — lowering
+    # runs at compile time, after we return — so the raised limit must
+    # persist (restoring it here would reinstate the RecursionError at
+    # the deferred compile).
+    import sys
+    if sys.getrecursionlimit() < 20000:
+        sys.setrecursionlimit(20000)
     out, tiny, nzero = pl.pallas_call(
         kern,
         grid=(N,),
@@ -203,15 +221,15 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
         ],
         out_specs=[
             pl.BlockSpec((1, mb, mb), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1,), lambda i: (i,),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda i: (i,),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, mb, mb), F.dtype),
-            jax.ShapeDtypeStruct((N,), jnp.int32),
-            jax.ShapeDtypeStruct((N,), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
         ],
         interpret=interpret,
     )(thresh_arr, F)
