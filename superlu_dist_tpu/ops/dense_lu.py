@@ -21,6 +21,7 @@ padded factorization equal the true one.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -127,25 +128,51 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
     nb = min(nb, wb)
     assert wb % nb == 0, "width buckets must be multiples of the block"
     rows = jnp.arange(mb)
+    rows_nb = jax.lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
+    cols_nb = jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1)
+
+    def _rank1_step(t, D, tiny, nzero):
+        """One masked rank-1 elimination step of the (nb, nb) diagonal
+        block.  `t` may be a traced index: column/row t are extracted
+        by iota-mask reductions and the update is a full-block outer
+        product that is exactly zero outside the trailing submatrix,
+        so the result is bitwise the sliced formulation's."""
+        is_t_col = cols_nb == t
+        ck = jnp.sum(jnp.where(is_t_col, D, 0), axis=1,
+                     keepdims=True)                       # (nb, 1)
+        piv = jnp.sum(jnp.where(rows_nb == t, ck, 0))
+        piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
+        below = rows_nb > t
+        scaled = jnp.where(below, ck / piv, ck)
+        newcol = jnp.where(rows_nb == t, piv, scaled)
+        D = jnp.where(is_t_col, newcol, D)
+        rk = jnp.sum(jnp.where(rows_nb == t, D, 0), axis=0,
+                     keepdims=True)                       # (1, nb)
+        # broadcast multiply, NOT (nb,1)@(1,nb): a matmul would run at
+        # the ambient matmul precision (bf16 single-pass for f32 off
+        # the _hi_prec paths); the elementwise product is exact
+        D = D - jnp.where(below, scaled, 0) * jnp.where(
+            cols_nb > t, rk, 0)
+        return D, tiny + was_tiny, nzero + was_zero
+
+    # chain-unroll granularity: the nb-step scalar critical path is
+    # unrolled in chunks of `cu` inside a fori_loop — full unrolling
+    # made program size (and so compile time) scale with the whole
+    # chain, while per-chunk unrolling keeps the fused-body count at
+    # nb/cu with compile cost O(cu)
+    cu = int(os.environ.get("SLU_DIAG_UNROLL", "8"))
+    cu = max(1, min(cu, nb))
+    while nb % cu:
+        cu -= 1
 
     def _factor_diag(D, tiny, nzero):
-        """Right-looking elimination of the (nb, nb) diagonal block,
-        statically unrolled: every index is a Python int, so the whole
-        nb-column chain is ONE fused loop-body instead of nb sequential
-        fori_loop dispatches (the scalar critical path of LU is
-        unavoidable; paying per-iteration dispatch latency for it is
-        not)."""
-        for t in range(nb):
-            piv, was_tiny, was_zero = _tiny_replace(D[t, t], thresh,
-                                                    dtype)
-            tiny = tiny + was_tiny
-            nzero = nzero + was_zero
-            ltail = D[t + 1:, t] / piv
-            utail = D[t, t + 1:]
-            D = D.at[t, t].set(piv)
-            D = D.at[t + 1:, t].set(ltail)
-            D = D.at[t + 1:, t + 1:].add(-jnp.outer(ltail, utail))
-        return D, tiny, nzero
+        def chunk(c, carry):
+            D, tiny, nzero = carry
+            for i in range(cu):
+                D, tiny, nzero = _rank1_step(c * cu + i, D, tiny,
+                                             nzero)
+            return D, tiny, nzero
+        return jax.lax.fori_loop(0, nb // cu, chunk, (D, tiny, nzero))
 
     def block_step(kb, carry):
         F, tiny, nzero = carry

@@ -124,7 +124,9 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
             panel = jnp.where(is_t, newcol, panel)
             rk = jnp.sum(jnp.where(rows_m == k, panel, 0), axis=0,
                          keepdims=True)                 # (1, nb)
-            upd = jnp.where(below, scaled, 0) @ jnp.where(
+            # broadcast multiply (exact), not a rank-1 matmul at the
+            # ambient (possibly bf16) matmul precision
+            upd = jnp.where(below, scaled, 0) * jnp.where(
                 cols_nb > t, rk, 0)
             panel = panel - upd
             return (panel, tiny + is_tiny.astype(jnp.int32),
@@ -175,7 +177,7 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
         newcol = jnp.where(is_k_row[:, :1], piv, scaled)
         F = jnp.where(is_k_col, newcol, F)
         rk = jnp.sum(jnp.where(is_k_row, F, 0), axis=0, keepdims=True)
-        upd = jnp.where(below, scaled, 0) @ jnp.where(
+        upd = jnp.where(below, scaled, 0) * jnp.where(
             cols[:1, :] > k, rk, 0)
         F = F - upd
         return (F, tiny + is_tiny.astype(jnp.int32),
