@@ -27,6 +27,20 @@ import jax
 import jax.numpy as jnp
 
 
+def _env_unroll(default: int = 8) -> int:
+    """SLU_DIAG_UNROLL, parsed once at import (jit caches are keyed by
+    shapes only, so a mid-process change could never take effect
+    anyway); malformed values fall back to the default."""
+    try:
+        v = int(os.environ.get("SLU_DIAG_UNROLL", default))
+    except (TypeError, ValueError):
+        return default
+    return v if v >= 1 else default
+
+
+_DIAG_UNROLL = _env_unroll()
+
+
 def _newton_tri_inverse(T, *, lower: bool, unit: bool):
     """inv(T) for batched (…, k, k) triangular T via Newton iteration
     X ← X(2I − TX).  For triangular T the error I − TX is nilpotent
@@ -55,8 +69,12 @@ def _newton_tri_inverse(T, *, lower: bool, unit: bool):
         X = eye - Nn
         A = eye + Nn
     steps = max(0, (k - 1).bit_length() - 1)
-    for _ in range(steps):
-        X = X @ (2 * eye - A @ X)
+    # fori_loop, not Python unroll: the two dots per step are the whole
+    # body, so unrolling only multiplies program size (compile time)
+    # without enabling any fusion
+    if steps > 0:
+        X = jax.lax.fori_loop(
+            0, steps, lambda _, X: X @ (2 * eye - A @ X), X)
     if not unit:
         X = X / jnp.swapaxes(d, -1, -2)         # inv = inv(I+D⁻¹N)·D⁻¹
     return X
@@ -160,8 +178,7 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
     # made program size (and so compile time) scale with the whole
     # chain, while per-chunk unrolling keeps the fused-body count at
     # nb/cu with compile cost O(cu)
-    cu = int(os.environ.get("SLU_DIAG_UNROLL", "8"))
-    cu = max(1, min(cu, nb))
+    cu = max(1, min(_DIAG_UNROLL, nb))
     while nb % cu:
         cu -= 1
 

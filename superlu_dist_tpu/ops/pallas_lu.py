@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .dense_lu import _tiny_replace
+
 try:  # pallas is part of jax, but guard exotic builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -111,13 +113,8 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
             ck = jnp.sum(jnp.where(is_t, panel, 0), axis=1,
                          keepdims=True)                 # (mb, 1)
             piv = jnp.sum(jnp.where(rows_m == k, ck, 0))
-            apiv = jnp.abs(piv)
-            is_tiny = apiv < thresh
-            sgn = jnp.where(piv >= 0, jnp.ones((), dtype),
-                            -jnp.ones((), dtype))
-            piv = jnp.where(is_tiny, sgn * thresh, piv)
-            was_zero = jnp.logical_and(apiv == 0,
-                                       jnp.logical_not(is_tiny))
+            piv, was_tiny, was_zero = _tiny_replace(piv, thresh,
+                                                    dtype)
             below = rows_m > k
             scaled = jnp.where(below, ck / piv, ck)
             newcol = jnp.where(rows_m == k, piv, scaled)
@@ -129,8 +126,7 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
             upd = jnp.where(below, scaled, 0) * jnp.where(
                 cols_nb > t, rk, 0)
             panel = panel - upd
-            return (panel, tiny + is_tiny.astype(jnp.int32),
-                    nzero + was_zero.astype(jnp.int32))
+            return panel, tiny + was_tiny, nzero + was_zero
 
         panel, tiny, nzero = jax.lax.fori_loop(
             0, nb, t_step, (panel, tiny, nzero))
@@ -166,12 +162,7 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
         # column/row k via mask-reduce (dynamic lane slicing is slow)
         ck = jnp.sum(jnp.where(is_k_col, F, 0), axis=1, keepdims=True)
         piv = jnp.sum(jnp.where(is_k_col & is_k_row, F, 0))
-        apiv = jnp.abs(piv)
-        is_tiny = apiv < thresh
-        sgn = jnp.where(piv >= 0, jnp.ones((), dtype),
-                        -jnp.ones((), dtype))
-        piv = jnp.where(is_tiny, sgn * thresh, piv)
-        was_zero = jnp.logical_and(apiv == 0, jnp.logical_not(is_tiny))
+        piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
         below = rows[:, :1] > k
         scaled = jnp.where(below, ck / piv, ck)
         newcol = jnp.where(is_k_row[:, :1], piv, scaled)
@@ -180,8 +171,7 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
         upd = jnp.where(below, scaled, 0) * jnp.where(
             cols[:1, :] > k, rk, 0)
         F = F - upd
-        return (F, tiny + is_tiny.astype(jnp.int32),
-                nzero + was_zero.astype(jnp.int32))
+        return F, tiny + was_tiny, nzero + was_zero
 
     zero = jnp.zeros((), jnp.int32)
     F, tiny, nzero = jax.lax.fori_loop(0, wb, col_step, (F, zero, zero))
