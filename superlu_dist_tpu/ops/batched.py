@@ -109,6 +109,11 @@ class GroupSpec:
     # each device writes only its local slice — the gather-free
     # subforest interior of the 3D algorithm (SRC/pdgstrf3d.c:292)
     needs_gather: bool = True
+    # True for tree-top groups factored cooperatively: the front is
+    # replicated on every device (identical assembly indices) and the
+    # trailing GEMM is column-sharded (ops/coop_lu.py) — the TPU analog
+    # of the reference's 2D block-cyclic panel distribution
+    coop: bool = False
     _dev: Optional[dict] = None  # lazy device-array cache, keyed by squeeze
 
     def dev(self, squeeze: bool):
@@ -200,6 +205,16 @@ def _zone_assignment(fp, ndev: int) -> np.ndarray:
     return zone
 
 
+def _coop_mb_min() -> int:
+    """Minimum padded front size for cooperative (column-sharded)
+    factorization; SLU_COOP_MB overrides, 0 disables."""
+    import os
+    try:
+        return int(os.environ.get("SLU_COOP_MB", "256"))
+    except (TypeError, ValueError):
+        return 256
+
+
 def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     fp = plan.frontal
     part = fp.sym.part
@@ -209,6 +224,8 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     zone = _zone_assignment(fp, ndev)
     sparent = part.sparent
     sup_dev = np.zeros(fp.nsuper, dtype=np.int64)
+    coop_sup = np.zeros(fp.nsuper, dtype=bool)
+    coop_min = _coop_mb_min()
 
     sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
     groups: List[GroupSpec] = []
@@ -270,29 +287,44 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             N = len(slist)
             rb = mb - wb
 
-            # zone-affine placement: fronts stick to their subtree's
-            # device so interior extend-adds stay device-local; shared
-            # ancestors (zone −1) go to the least-loaded device.  A
-            # 2× padding guard falls back to round-robin (which then
-            # forces the gather) when zones are too skewed here.
-            per_dev_s: List[list] = [[] for _ in range(ndev)]
-            shared = []
-            for s in slist:
-                z = zone[s]
-                if 0 <= z < ndev:
-                    per_dev_s[z].append(s)
-                else:
-                    shared.append(s)
-            for s in shared:
-                d = min(range(ndev), key=lambda t: len(per_dev_s[t]))
-                per_dev_s[d].append(s)
-            maxc = max(len(v) for v in per_dev_s)
-            if maxc > 2 * (-(-N // ndev)):
-                # skewed zones would blow padding; round-robin instead
-                # (needs_gather is settled exactly in the post-pass
-                # below, from ACTUAL placements)
-                per_dev_s = [list(slist[d::ndev]) for d in range(ndev)]
+            # tree-top groups with fewer fronts than half the devices
+            # factor cooperatively: the front replicates on every
+            # device and its trailing GEMM shards by column slices
+            # (ops/coop_lu.py) — the 2D-block-cyclic-panel analog that
+            # removes the one-device-factors-the-root Amdahl cap
+            coop = (ndev > 1 and coop_min > 0 and mb >= coop_min
+                    and 2 * N <= ndev)
+            if coop:
+                per_dev_s = [list(slist) for _ in range(ndev)]
+                maxc = N
+                coop_sup[slist] = True
+            else:
+                # zone-affine placement: fronts stick to their
+                # subtree's device so interior extend-adds stay
+                # device-local; shared ancestors (zone −1) go to the
+                # least-loaded device.  A 2× padding guard falls back
+                # to round-robin (which then forces the gather) when
+                # zones are too skewed here.
+                per_dev_s = [[] for _ in range(ndev)]
+                shared = []
+                for s in slist:
+                    z = zone[s]
+                    if 0 <= z < ndev:
+                        per_dev_s[z].append(s)
+                    else:
+                        shared.append(s)
+                for s in shared:
+                    d = min(range(ndev),
+                            key=lambda t: len(per_dev_s[t]))
+                    per_dev_s[d].append(s)
                 maxc = max(len(v) for v in per_dev_s)
+                if maxc > 2 * (-(-N // ndev)):
+                    # skewed zones would blow padding; round-robin
+                    # instead (needs_gather is settled exactly in the
+                    # post-pass below, from ACTUAL placements)
+                    per_dev_s = [list(slist[d::ndev])
+                                 for d in range(ndev)]
+                    maxc = max(len(v) for v in per_dev_s)
 
             # pad per-device count to the {2^k, 1.5·2^k} grid
             n_loc = _next_bucket(maxc)
@@ -311,7 +343,11 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         remaining[gc] -= 1
                         if remaining[gc] == 0:
                             _free(gc)
-            upd_off = _alloc(n_tot * rb * rb)
+            # coop groups keep ONE (owner-slot) copy of their slab:
+            # every device writes the identical replicated content at
+            # the same offset, so no device-major fan-out is needed
+            slab_sz = (n_loc if coop else n_tot) * rb * rb
+            upd_off = _alloc(slab_sz)
 
             sup_pos = np.empty(len(slist), dtype=np.int64)
             pos_of = {s: i for i, s in enumerate(slist)}
@@ -347,11 +383,19 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         per_dev["ea_dst"][d].append(
                             (base + pos[:, None] * mb
                              + pos[None, :]).ravel())
+                    if coop and d > 0:
+                        # replicated fronts: factor work is shared, but
+                        # ownership (slab slot, solve updates, diag-U
+                        # extraction) is pinned to device 0 — solve
+                        # indices stay dummies off-owner so the psum of
+                        # sweep deltas counts each front once
+                        continue
                     col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
                     struct_idx[d, b, :r] = fp.sym.struct[s]
                     # global update slab is device-major contiguous so an
                     # all_gather of local slabs reproduces it exactly
-                    sup_upd_off[s] = upd_off + bg * rb * rb
+                    # (coop slabs: single owner-slot copy, bg = b)
+                    sup_upd_off[s] = upd_off + (b if coop else bg) * rb * rb
                     sup_dev[s] = d
                     sup_pos[pos_of[s]] = bg
             # dummy fronts (including wholly idle devices): identity
@@ -393,9 +437,10 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 ea_dst=stack("ea_dst", f_loc),
                 col_idx=col_idx, struct_idx=struct_idx,
                 upd_off_global=upd_off,
-                L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur))
+                L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur,
+                coop=coop))
             gi = len(groups) - 1
-            group_alloc[gi] = (upd_off, n_tot * rb * rb)
+            group_alloc[gi] = (upd_off, slab_sz)
             for s in slist:
                 group_of_sup[s] = gi
             nread = sum(1 for s in slist if fp.r[s] > 0)
@@ -416,10 +461,17 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     # group's slab may skip its all_gather exactly when every consumer
     # of every front in it lives on the producing device.  Zones only
     # GUIDE placement; this decision never assumes they were honored.
+    # Coop groups never gather (every device already holds the full
+    # owner-slot slab locally); their CHILDREN always must (the coop
+    # parent's replicated assembly reads every child slab everywhere).
     for g in groups:
+        if g.coop:
+            g.needs_gather = False
+            continue
         g.needs_gather = ndev > 1 and any(
             fp.r[int(s)] > 0
-            and sup_dev[int(sparent[int(s)])] != sup_dev[int(s)]
+            and (coop_sup[int(sparent[int(s)])]
+                 or sup_dev[int(sparent[int(s)])] != sup_dev[int(s)])
             for s in g.sup_ids)
 
     return BatchedSchedule(groups=groups, ndev=ndev, n=n,
@@ -433,9 +485,12 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     cache = getattr(plan, "_batched_schedules", None)
     if cache is None:
         cache = plan._batched_schedules = {}
-    if ndev not in cache:
-        cache[ndev] = build_schedule(plan, ndev)
-    return cache[ndev]
+    # the coop threshold participates in the key so a mid-process
+    # SLU_COOP_MB change takes effect instead of hitting a stale entry
+    key = (ndev, _coop_mb_min() if ndev > 1 else 0)
+    if key not in cache:
+        cache[key] = build_schedule(plan, ndev)
+    return cache[key]
 
 
 def _thresh_for(plan: FactorPlan, dtype: np.dtype) -> float:
@@ -488,7 +543,8 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
                        Ui_off, *, mb: int, wb: int, n_pad: int,
                        axis: Optional[str] = None,
-                       gather: bool = True):
+                       gather: bool = True, coop: bool = False,
+                       ndev: int = 1):
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     F = jnp.zeros(n_pad * mb * mb, dtype)
@@ -500,7 +556,18 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
     F = F.reshape(n_pad, mb, mb)
 
-    F, tiny_g, nzero_g = partial_lu_batch(F, thresh, wb=wb)
+    if coop and axis is not None:
+        # replicated tree-top fronts: cooperative column-sharded LU
+        # (the 2D-block-cyclic-panel analog); counters replicate, so
+        # take them from the owner device only
+        from .coop_lu import coop_partial_lu_batch
+        F, tiny_g, nzero_g = coop_partial_lu_batch(
+            F, thresh, wb=wb, ndev=ndev, axis=axis)
+        on_owner = (_flat_axis_index(axis) == 0).astype(jnp.int32)
+        tiny_g = tiny_g * on_owner
+        nzero_g = nzero_g * on_owner
+    else:
+        F, tiny_g, nzero_g = partial_lu_batch(F, thresh, wb=wb)
 
     rows = jnp.arange(mb)[:, None]
     colsw = jnp.arange(wb)[None, :]
@@ -520,7 +587,12 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                                            (Ui_off,))
     if mb > wb:
         upd = F[:, wb:, wb:].reshape(-1)
-        if axis is not None and gather:
+        if axis is not None and coop:
+            # replicated coop content: every device writes the SAME
+            # values at the single owner-slot offset, so consumers on
+            # any device read it locally — no gather ever needed
+            off = upd_off
+        elif axis is not None and gather:
             # ancestor propagation: the reference's dreduceAncestors3d /
             # Z-axis panel exchange becomes one tiled all_gather along
             # the mesh axis — device-major local slabs concatenate into

@@ -91,7 +91,8 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
             jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
             jnp.int32(g.U_off), jnp.int32(g.Li_off),
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-            axis=axis, gather=g.needs_gather)
+            axis=axis, gather=g.needs_gather, coop=g.coop,
+            ndev=dsched.ndev)
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
 
 

@@ -1,0 +1,138 @@
+"""Cooperative (column-sharded) big-front factorization — the TPU
+analog of the reference's 2D block-cyclic panel distribution
+(SRC/superlu_defs.h:357-382): tree-top groups replicate their fronts
+on every device and shard the trailing GEMM by column slices
+(ops/coop_lu.py), removing the one-device-factors-the-root cap.
+
+All tests force coop onto small fronts with SLU_COOP_MB and compare
+against the single-device oracle, which never uses coop."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from superlu_dist_tpu import Options, csr_from_scipy
+from superlu_dist_tpu.ops import batched
+from superlu_dist_tpu.ops.batched import (factorize_device,
+                                          get_schedule, solve_device)
+from superlu_dist_tpu.parallel.factor_dist import (dist_solve,
+                                                   make_dist_factor,
+                                                   make_dist_step)
+from superlu_dist_tpu.parallel.grid import make_solver_mesh
+from superlu_dist_tpu.plan.plan import plan_factorization
+
+
+@pytest.fixture
+def force_coop(monkeypatch):
+    monkeypatch.setenv("SLU_COOP_MB", "32")
+
+
+def _problem(n1=40, complex_=False):
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(n1, n1))
+    A = sp.kronsum(t, t, format="csr")
+    if complex_:
+        A = (A + 1j * sp.diags(np.linspace(0.1, 0.4, A.shape[0]))).tocsr()
+    a = csr_from_scipy(A)
+    rng = np.random.default_rng(0)
+    xtrue = rng.standard_normal((a.n, 2))
+    if complex_:
+        xtrue = xtrue + 1j * rng.standard_normal((a.n, 2))
+    return a, A, xtrue, A @ xtrue
+
+
+def test_coop_groups_appear_at_tree_top(force_coop):
+    """Tree-top groups with few fronts become coop groups, their slabs
+    never gather, and their children always do."""
+    a, _, _, _ = _problem(40)
+    plan = plan_factorization(a, Options())
+    sched = get_schedule(plan, 8)
+    coop = [g for g in sched.groups if g.coop]
+    assert coop, "no coop group formed — test setup ineffective"
+    assert all(2 * g.n_true <= 8 for g in coop)
+    assert all(not g.needs_gather for g in coop)
+    # children of coop fronts must gather (replicated consumers)
+    coop_sups = {int(s) for g in coop for s in g.sup_ids}
+    sparent = plan.frontal.sym.part.sparent
+    for g in sched.groups:
+        if g.coop:
+            continue
+        if any(int(sparent[int(s)]) in coop_sups
+               and plan.frontal.r[int(s)] > 0 for s in g.sup_ids):
+            assert g.needs_gather
+
+
+def test_coop_dist_step_matches_single_device(force_coop):
+    a, A, xtrue, b = _problem(40)
+    plan = plan_factorization(a, Options())
+    sched = get_schedule(plan, 8)
+    assert any(g.coop for g in sched.groups)
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    g = make_solver_mesh(2, 2, 2)
+    step, _ = make_dist_step(plan, g.mesh)
+    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+    lu1 = factorize_device(plan, vals)
+    x1 = solve_device(lu1, bf)
+    assert np.allclose(x, x1, atol=1e-10)
+
+
+def test_coop_split_factor_solve(force_coop):
+    a, A, xtrue, b = _problem(40)
+    plan = plan_factorization(a, Options())
+    vals = plan.scaled_values(a.data)
+    g = make_solver_mesh(4, 2)
+    factor = make_dist_factor(plan, g.mesh)
+    dlu = factor(jnp.asarray(vals))
+    bf = b[plan.final_row]
+    x = np.asarray(dist_solve(dlu, jnp.asarray(bf)))
+    lu1 = factorize_device(plan, vals)
+    x1 = solve_device(lu1, bf)
+    assert np.allclose(x, x1, atol=1e-10)
+
+
+def test_coop_gssvx_and_diag_u(force_coop):
+    from superlu_dist_tpu import gssvx
+    from superlu_dist_tpu.models.gssvx import factorize, get_diag_u
+
+    a, A, xtrue, b = _problem(24)
+    g = make_solver_mesh(2, 2, 2)
+    x, lu, _ = gssvx(Options(), a, b[:, 0], grid=g)
+    assert np.allclose(x, xtrue[:, 0], atol=1e-8)
+    d_dist = np.asarray(get_diag_u(lu))
+    lu_ref = factorize(a, Options(), backend="host")
+    d_ref = np.asarray(get_diag_u(lu_ref))
+    np.testing.assert_allclose(np.abs(d_dist), np.abs(d_ref),
+                               rtol=1e-10)
+
+
+def test_coop_complex(force_coop):
+    a, A, xtrue, b = _problem(24, complex_=True)
+    plan = plan_factorization(a, Options())
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    g = make_solver_mesh(2, 2, 2)
+    step, _ = make_dist_step(plan, g.mesh, dtype=np.complex128)
+    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+    lu1 = factorize_device(plan, vals, dtype=np.complex128)
+    x1 = solve_device(lu1, bf)
+    assert np.allclose(x, x1, atol=1e-10)
+
+
+def test_coop_mesh_shape_invariance(force_coop):
+    a, A, xtrue, b = _problem(30)
+    plan = plan_factorization(a, Options())
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    ref = None
+    for shape in ((8,), (2, 4), (2, 2, 2)):
+        g = make_solver_mesh(*shape)
+        step, _ = make_dist_step(plan, g.mesh)
+        x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+        if ref is None:
+            ref = x
+        else:
+            assert np.allclose(x, ref, atol=1e-10)
