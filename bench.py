@@ -47,10 +47,13 @@ def main():
             pass
     try:
         # persistent compilation cache: repeated bench runs (and the
-        # per-round driver invocation) skip the fused-program compile
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(
-                              os.path.abspath(__file__)), ".jax_cache"))
+        # per-round driver invocation) skip the fused-program compile.
+        # Host-fingerprinted dir: CPU AOT entries from another machine
+        # type misload (wrong code / SIGILL).
+        from superlu_dist_tpu.utils.cache import host_cache_dir
+        jax.config.update("jax_compilation_cache_dir", host_cache_dir(
+            os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), ".jax_cache")))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     except Exception:
         pass

@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trans", default="NOTRANS",
                    choices=[m.name for m in Trans])
     p.add_argument("--no-equil", action="store_true")
+    p.add_argument("--autotune", action="store_true",
+                   help="refit padding bucket grids to this pattern "
+                        "(one extra symbolic pass)")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of the solve "
@@ -93,6 +96,9 @@ def main(argv=None) -> int:
         row_perm=RowPerm[args.rowperm],
         iter_refine=IterRefine[args.refine],
         trans=Trans[args.trans],
+        # only override when the flag is given so the SUPERLU_AUTOTUNE
+        # env default (options.py) still applies without it
+        **({"autotune": True} if args.autotune else {}),
     )
 
     if args.verbose:

@@ -143,6 +143,11 @@ class Options:
     width_buckets: tuple = (8, 16, 32, 64, 128, 256, 512)
     front_buckets: tuple = (16, 32, 64, 128, 256, 384, 512, 768, 1024,
                             1536, 2048, 3072, 4096, 6144, 8192)
+    # refit the bucket grids to this pattern's supernode population
+    # before the final plan (plan/autotune.py; sp_ienv tuning analog).
+    # Costs one extra symbolic pass, pays back in padded-flop waste.
+    autotune: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SUPERLU_AUTOTUNE", 0)))
 
     # --- distribution ---
     # 3D-algorithm analog: number of forest levels replicated over the

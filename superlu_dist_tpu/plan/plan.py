@@ -73,12 +73,15 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
                        stats: Stats | None = None,
                        user_perm_r: np.ndarray | None = None,
                        user_perm_c: np.ndarray | None = None,
-                       autotune: bool = False) -> FactorPlan:
+                       autotune: bool | None = None) -> FactorPlan:
     """Run the full preprocessing pipeline on the host.  With
-    `autotune`, the padding bucket grids are refit to this pattern's
-    supernode population (plan/autotune.py) and the frontal maps
-    rebuilt — a once-per-pattern cost, like the rest of the plan."""
+    `autotune` (default: options.autotune), the padding bucket grids
+    are refit to this pattern's supernode population (plan/autotune.py)
+    and the frontal maps rebuilt — a once-per-pattern cost, like the
+    rest of the plan."""
     options = options or Options()
+    if autotune is None:
+        autotune = bool(getattr(options, "autotune", False))
     stats = stats if stats is not None else Stats()
     if a.m != a.n:
         raise ValueError("solver requires a square matrix")

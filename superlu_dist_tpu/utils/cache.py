@@ -1,0 +1,32 @@
+"""Host-fingerprinted persistent-compile-cache directory.
+
+XLA:CPU AOT cache entries embed the COMPILING machine's feature set;
+loading them on a host with different CPU features is at best a loud
+warning and at worst wrong code (cpu_aot_loader "could lead to
+execution errors such as SIGILL").  Workspaces here migrate between
+machines, so the cache directory name carries a fingerprint of the
+host's CPU flags — each machine type gets its own cache and never
+loads another's objects.  TPU entries are keyed by device target
+already, but the per-host split is harmless there.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import platform
+import re
+
+
+def host_cache_dir(base: str) -> str:
+    """`base` extended with a stable fingerprint of this host's CPU."""
+    key = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            m = re.search(r"^flags\s*:\s*(.*)$", f.read(), re.M)
+        if m:
+            key = " ".join(sorted(m.group(1).split()))
+    except OSError:
+        pass
+    if not key:
+        key = f"{platform.machine()}-{platform.processor()}"
+    return f"{base}-{hashlib.sha1(key.encode()).hexdigest()[:12]}"

@@ -19,8 +19,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: the suite re-jits the same group programs
-# every run; caching cuts a cold 20-minute run to a few minutes
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
+# every run; caching cuts a cold 20-minute run to a few minutes.
+# The directory is fingerprinted by host CPU flags — XLA:CPU AOT
+# entries from a different machine type misload (cpu_aot_loader
+# SIGILL/wrong-code warning; observed as flaky numerics).
+import sys  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from superlu_dist_tpu.utils.cache import host_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", host_cache_dir(
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)

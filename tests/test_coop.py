@@ -77,7 +77,8 @@ def test_coop_dist_step_matches_single_device(force_coop):
     x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
     lu1 = factorize_device(plan, vals)
     x1 = solve_device(lu1, bf)
-    assert np.allclose(x, x1, atol=1e-10)
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"
 
 
 def test_coop_split_factor_solve(force_coop):
@@ -91,7 +92,8 @@ def test_coop_split_factor_solve(force_coop):
     x = np.asarray(dist_solve(dlu, jnp.asarray(bf)))
     lu1 = factorize_device(plan, vals)
     x1 = solve_device(lu1, bf)
-    assert np.allclose(x, x1, atol=1e-10)
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"
 
 
 def test_coop_gssvx_and_diag_u(force_coop):
@@ -119,7 +121,28 @@ def test_coop_complex(force_coop):
     x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
     lu1 = factorize_device(plan, vals, dtype=np.complex128)
     x1 = solve_device(lu1, bf)
-    assert np.allclose(x, x1, atol=1e-10)
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"
+
+
+def test_coop_uneven_column_slices(force_coop):
+    """ndev that does not divide mb exercises the padded-column path
+    (mbp > mb) in coop_lu."""
+    a, A, xtrue, b = _problem(30)
+    plan = plan_factorization(a, Options())
+    sched = get_schedule(plan, 6)
+    coop = [g for g in sched.groups if g.coop]
+    assert any(g.mb % 6 for g in coop), \
+        "no coop group with mb % ndev != 0 — padding path untested"
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    g = make_solver_mesh(3, 2)
+    step, _ = make_dist_step(plan, g.mesh)
+    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+    lu1 = factorize_device(plan, vals)
+    x1 = solve_device(lu1, bf)
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"
 
 
 def test_coop_mesh_shape_invariance(force_coop):
