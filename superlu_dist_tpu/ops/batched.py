@@ -114,6 +114,14 @@ class GroupSpec:
     # trailing GEMM is column-sharded (ops/coop_lu.py) — the TPU analog
     # of the reference's 2D block-cyclic panel distribution
     coop: bool = False
+    # solve-sweep sync points (axis mode): X is reconciled by psum only
+    # BEFORE groups that read rows other devices may have written —
+    # fwd: some front has a cross-device descendant; bwd: a cross-
+    # device ancestor.  Zone-affine interiors then run sweep steps
+    # with zero collectives (the C_Tree forest of pdgstrs collapsed
+    # further: one reduction per zone boundary, not per supernode)
+    fwd_sync: bool = True
+    bwd_sync: bool = True
     _dev: Optional[dict] = None  # lazy device-array cache, keyed by squeeze
 
     def dev(self, squeeze: bool):
@@ -474,6 +482,31 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                  or sup_dev[int(sparent[int(s)])] != sup_dev[int(s)])
             for s in g.sup_ids)
 
+    # solve-sync post-pass: a sweep step must see a replicated X only
+    # when other devices may have written rows it reads.  fwd reads
+    # X[cols(s)], accumulated by s's DESCENDANTS; bwd reads
+    # X[struct(s)] ⊆ ancestor columns, set by s's ANCESTORS.  Coop
+    # fronts run their solve updates on device 0 (sup_dev == 0), so
+    # the same device comparison covers them.
+    if ndev > 1:
+        ns = fp.nsuper
+        cross_desc = np.zeros(ns, dtype=bool)
+        anc_cross = np.zeros(ns, dtype=bool)
+        for s in range(ns):            # postorder: children first
+            p = int(sparent[s])
+            if p >= 0 and (cross_desc[s] or sup_dev[s] != sup_dev[p]):
+                cross_desc[p] = True
+        for s in range(ns - 1, -1, -1):  # parents first
+            p = int(sparent[s])
+            if p >= 0:
+                anc_cross[s] = bool(anc_cross[p]
+                                    or sup_dev[p] != sup_dev[s])
+        for g in groups:
+            g.fwd_sync = bool(any(cross_desc[int(s)]
+                                  for s in g.sup_ids))
+            g.bwd_sync = bool(any(anc_cross[int(s)]
+                                  for s in g.sup_ids))
+
     return BatchedSchedule(groups=groups, ndev=ndev, n=n,
                            upd_total=upd_peak,
                            L_total=L_cur, U_total=U_cur,
@@ -616,34 +649,26 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
 
 
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
-                    Li_off, *, mb: int, wb: int, n_pad: int,
-                    axis: Optional[str] = None):
+                    Li_off, *, mb: int, wb: int, n_pad: int):
+    """Device-local sweep step: in distributed mode each device runs
+    this on its own X copy (dummy indices elsewhere) and _solve_loop
+    reconciles by psum-of-diffs at its static sync points."""
     xb = X[col_idx]                                     # (Np, wb, nrhs)
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     y = Li @ xb
+    X = X.at[col_idx].set(y)
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-    if axis is None:
-        X = X.at[col_idx].set(y)
-        if mb > wb:
-            X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
-        return X
-    # distributed: each device owns a disjoint set of fronts, so the
-    # psum of disjoint deltas is the C_Tree reduce forest of pdgstrs
-    # (SRC/pdgstrs.c:2133-2139) collapsed into one collective
-    delta = jnp.zeros_like(X).at[col_idx].add(y - xb)
-    if mb > wb:
-        delta = delta.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
-    return X + jax.lax.psum(delta, axis)
+        X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
+    return X
 
 
 
 
 def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
-                    Ui_off, *, mb: int, wb: int, n_pad: int,
-                    axis: Optional[str] = None):
+                    Ui_off, *, mb: int, wb: int, n_pad: int):
     xb = X[col_idx]
     if mb > wb:
         Up = jax.lax.dynamic_slice(
@@ -655,10 +680,7 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     x1 = Ui @ rhs
-    if axis is None:
-        return X.at[col_idx].set(x1)
-    delta = jnp.zeros_like(X).at[col_idx].add(x1 - xb)
-    return X + jax.lax.psum(delta, axis)
+    return X.at[col_idx].set(x1)
 
 
 
@@ -668,33 +690,24 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
 # on the fly (einsum-transpose is free on the MXU)
 
 def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
-                      Ui_off, *, mb: int, wb: int, n_pad: int,
-                      axis: Optional[str] = None):
+                      Ui_off, *, mb: int, wb: int, n_pad: int):
     xb = X[col_idx]
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     y = jnp.einsum("nwv,nwr->nvr", Ui, xb)          # Uiᵀ @ xb
+    X = X.at[col_idx].set(y)
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
-    if axis is None:
-        X = X.at[col_idx].set(y)
-        if mb > wb:
-            X = X.at[struct_idx].add(
-                -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y))
-        return X
-    delta = jnp.zeros_like(X).at[col_idx].add(y - xb)
-    if mb > wb:
-        delta = delta.at[struct_idx].add(
+        X = X.at[struct_idx].add(
             -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y))
-    return X + jax.lax.psum(delta, axis)
+    return X
 
 
 
 
 def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
-                      Li_off, *, mb: int, wb: int, n_pad: int,
-                      axis: Optional[str] = None):
+                      Li_off, *, mb: int, wb: int, n_pad: int):
     xb = X[col_idx]
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
@@ -706,10 +719,7 @@ def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     x1 = jnp.einsum("nwv,nwr->nvr", Li, rhs)        # Liᵀ @ rhs
-    if axis is None:
-        return X.at[col_idx].set(x1)
-    delta = jnp.zeros_like(X).at[col_idx].add(x1 - xb)
-    return X + jax.lax.psum(delta, axis)
+    return X.at[col_idx].set(x1)
 
 
 

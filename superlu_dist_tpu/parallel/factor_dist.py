@@ -16,9 +16,12 @@ compiled program:
   * Schur/update propagation: `all_gather` of the level's update slab
     (device-major contiguous layout makes the gather exactly the
     reference's gather of ancestor contributions);
-  * triangular solve sweeps: per-level `psum` of disjoint X deltas
-    (the C_Tree bcast/reduce forest of pdgstrs, SRC/pdgstrs.c:2133,
-    collapsed into level-synchronous collectives);
+  * triangular solve sweeps: device-local updates reconciled by a
+    psum-of-diffs only at static sync points — groups whose fronts
+    have cross-device descendants (forward) or ancestors (backward).
+    Zone-affine subtree interiors sweep with ZERO collectives (the
+    C_Tree bcast/reduce forest of pdgstrs, SRC/pdgstrs.c:2133,
+    collapsed to one reduction per zone boundary);
   * factor panels stay device-resident and device-sharded (the
     dLocalLU_t distribution, SRC/superlu_ddefs.h:97-263) — `DistLU`
     persists them across solves, the distributed FACTORED rung.
@@ -100,36 +103,51 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
 def _solve_loop(dsched, flats, b, dtype, per_group, axis,
                 trans: bool):
     """Shared triangular-sweep loop (runs inside shard_map).
-    `per_group` entries are (col_idx, struct_idx) pairs."""
+    `per_group` entries are (col_idx, struct_idx) pairs.
+
+    Axis mode runs every group's updates DEVICE-LOCALLY (the impls'
+    axis=None branch) and reconciles X by one psum-of-diffs only at
+    the schedule's static sync points (GroupSpec.fwd_sync/bwd_sync):
+    zone-affine subtree interiors sweep with zero collectives, the
+    pdgstrs C_Tree forest (SRC/pdgstrs.c:2133) collapsed to one
+    reduction per zone boundary."""
     L_flat, U_flat, Li_flat, Ui_flat = flats
     n = dsched.n
     xdt = jnp.promote_types(dtype, b.dtype)
     X = jnp.zeros((n + 1, b.shape[1]), xdt)
     X = X.at[:n, :].set(b.astype(xdt))
+    Xs = X                       # last reconciled snapshot (axis mode)
+
+    def sync(X, Xs):
+        Xn = Xs + jax.lax.psum(X - Xs, axis)
+        return Xn, Xn
+
     if not trans:
-        for g, (ci, si) in zip(dsched.groups, per_group):
-            X = _fwd_group_impl(X, L_flat, Li_flat, ci, si,
-                                jnp.int32(g.L_off), jnp.int32(g.Li_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                axis=axis)
-        for g, (ci, si) in zip(reversed(dsched.groups),
-                               reversed(per_group)):
-            X = _bwd_group_impl(X, U_flat, Ui_flat, ci, si,
-                                jnp.int32(g.U_off), jnp.int32(g.Ui_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                axis=axis)
+        fwd_fn, fwd_flats = _fwd_group_impl, (L_flat, Li_flat)
+        bwd_fn, bwd_flats = _bwd_group_impl, (U_flat, Ui_flat)
+        fwd_offs = lambda g: (jnp.int32(g.L_off), jnp.int32(g.Li_off))
+        bwd_offs = lambda g: (jnp.int32(g.U_off), jnp.int32(g.Ui_off))
     else:
-        for g, (ci, si) in zip(dsched.groups, per_group):
-            X = _fwd_group_T_impl(X, U_flat, Ui_flat, ci, si,
-                                  jnp.int32(g.U_off),
-                                  jnp.int32(g.Ui_off), mb=g.mb,
-                                  wb=g.wb, n_pad=g.n_loc, axis=axis)
-        for g, (ci, si) in zip(reversed(dsched.groups),
-                               reversed(per_group)):
-            X = _bwd_group_T_impl(X, L_flat, Li_flat, ci, si,
-                                  jnp.int32(g.L_off),
-                                  jnp.int32(g.Li_off), mb=g.mb,
-                                  wb=g.wb, n_pad=g.n_loc, axis=axis)
+        fwd_fn, fwd_flats = _fwd_group_T_impl, (U_flat, Ui_flat)
+        bwd_fn, bwd_flats = _bwd_group_T_impl, (L_flat, Li_flat)
+        fwd_offs = lambda g: (jnp.int32(g.U_off), jnp.int32(g.Ui_off))
+        bwd_offs = lambda g: (jnp.int32(g.L_off), jnp.int32(g.Li_off))
+
+    for g, (ci, si) in zip(dsched.groups, per_group):
+        if axis is not None and g.fwd_sync:
+            X, Xs = sync(X, Xs)
+        X = fwd_fn(X, *fwd_flats, ci, si, *fwd_offs(g),
+                   mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+    if axis is not None:
+        X, Xs = sync(X, Xs)      # complete forward solution
+    for g, (ci, si) in zip(reversed(dsched.groups),
+                           reversed(per_group)):
+        if axis is not None and g.bwd_sync:
+            X, Xs = sync(X, Xs)
+        X = bwd_fn(X, *bwd_flats, ci, si, *bwd_offs(g),
+                   mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+    if axis is not None:
+        X, _ = sync(X, Xs)       # replicate the final solution
     return X[:n]
 
 

@@ -171,3 +171,33 @@ def test_dist_backend_trans():
     g = make_solver_mesh(1, 1, 4)
     x, _, _ = gssvx(Options(trans=Trans.TRANS), a, b, grid=g)
     assert np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue) < 1e-10
+
+
+def test_solve_sync_elision():
+    """Zone-affine interiors sweep without collectives: the compiled
+    dist solve carries exactly one psum per sync point (plus the two
+    sweep-boundary reconciliations), not one per group."""
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import get_schedule
+    from superlu_dist_tpu.parallel.factor_dist import make_dist_solve
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.sparse import csr_from_scipy
+
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(40, 40))
+    a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+    plan = plan_factorization(a, Options())
+    sched = get_schedule(plan, 8)
+    nsync = (sum(1 for g in sched.groups if g.fwd_sync)
+             + sum(1 for g in sched.groups if g.bwd_sync))
+    assert nsync < 2 * len(sched.groups), "no interior group elided"
+    g = make_solver_mesh(2, 2, 2)
+    solve = make_dist_solve(plan, g.mesh)
+    dummy = [jnp.zeros(s * 8, np.float64) for s in
+             (sched.L_total, sched.U_total, sched.Li_total,
+              sched.Ui_total)]
+    txt = solve.lower(*dummy,
+                      jnp.zeros((plan.n, 1))).compile().as_text()
+    n_ar = txt.count("all-reduce(") + txt.count("all-reduce-start(")
+    assert n_ar <= nsync + 2, (n_ar, nsync)
