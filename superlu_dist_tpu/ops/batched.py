@@ -164,6 +164,35 @@ class BatchedSchedule:
     Ui_total: int
     sup_dev: np.ndarray = None  # front -> device placement
 
+    def comm_summary(self, dtype=np.float64, nrhs: int = 1) -> dict:
+        """Static per-step collective traffic (the SCT_t comm-volume
+        counters, SRC/util_dist.h:194-317, computed from the schedule
+        instead of measured): words moved by factor all_gathers, coop
+        panel/trailing psums, and solve sync psums."""
+        it = np.dtype(dtype).itemsize
+        gather_b = sum(g.n_loc * self.ndev * (g.mb - g.wb) ** 2 * it
+                       for g in self.groups
+                       if g.needs_gather and g.mb > g.wb)
+        coop_b = 0
+        for g in self.groups:
+            if g.coop:
+                # panel psums total mb·wb words regardless of the
+                # panel block size; trailing psum covers the padded
+                # column remainder
+                cb = -(-g.mb // self.ndev)
+                coop_b += g.n_loc * it * (
+                    g.wb * g.mb
+                    + g.mb * (cb * self.ndev - g.wb))
+        syncs = (sum(1 for g in self.groups if g.fwd_sync)
+                 + sum(1 for g in self.groups if g.bwd_sync) + 2)
+        return {
+            "factor_allgather_bytes": int(gather_b),
+            "coop_psum_bytes": int(coop_b),
+            "solve_syncs": int(syncs) if self.ndev > 1 else 0,
+            "solve_sync_bytes": (int(syncs * (self.n + 1) * nrhs * it)
+                                 if self.ndev > 1 else 0),
+        }
+
 
 def _zone_assignment(fp, ndev: int) -> np.ndarray:
     """Subtree-affine device zones — the greedy load-balanced forest

@@ -201,3 +201,55 @@ def test_solve_sync_elision():
                       jnp.zeros((plan.n, 1))).compile().as_text()
     n_ar = txt.count("all-reduce(") + txt.count("all-reduce-start(")
     assert n_ar <= nsync + 2, (n_ar, nsync)
+
+
+def test_comm_summary_accounting():
+    """Static collective-traffic accounting (SCT comm-volume analog)
+    is zero single-device and consistent with the schedule flags on a
+    mesh."""
+    import scipy.sparse as sp
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import get_schedule
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.sparse import csr_from_scipy
+
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(40, 40))
+    a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+    plan = plan_factorization(a, Options())
+    s1 = get_schedule(plan, 1)
+    assert all(v == 0 for v in s1.comm_summary().values())
+    s8 = get_schedule(plan, 8)
+    cs = s8.comm_summary(np.float32, nrhs=2)
+    nsync = (sum(1 for g in s8.groups if g.fwd_sync)
+             + sum(1 for g in s8.groups if g.bwd_sync) + 2)
+    assert cs["solve_syncs"] == nsync
+    assert cs["solve_sync_bytes"] == nsync * (plan.n + 1) * 2 * 4
+    assert cs["factor_allgather_bytes"] > 0
+    assert cs["coop_psum_bytes"] == 0    # no coop at default threshold
+
+
+def test_comm_summary_coop_bytes(monkeypatch):
+    """Coop traffic accounting matches the collectives coop_lu
+    actually issues: wb/pb panel psums of (mb, pb) + one trailing
+    (mb, mbp - wb) psum per front."""
+    import scipy.sparse as sp
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import get_schedule
+    from superlu_dist_tpu.ops.coop_lu import _pick_pb
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.sparse import csr_from_scipy
+
+    monkeypatch.setenv("SLU_COOP_MB", "32")
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(40, 40))
+    a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+    plan = plan_factorization(a, Options())
+    s = get_schedule(plan, 8)
+    coop = [g for g in s.groups if g.coop]
+    assert coop
+    expect = 0
+    for g in coop:
+        pb = _pick_pb(g.wb)
+        cb = -(-g.mb // 8)
+        per_front = (g.wb // pb) * g.mb * pb + g.mb * (cb * 8 - g.wb)
+        expect += g.n_loc * per_front * 4
+    assert s.comm_summary(np.float32)["coop_psum_bytes"] == expect
