@@ -201,6 +201,10 @@ def test_solve_sync_elision():
                       jnp.zeros((plan.n, 1))).compile().as_text()
     n_ar = txt.count("all-reduce(") + txt.count("all-reduce-start(")
     assert n_ar <= nsync + 2, (n_ar, nsync)
+    # the compiled collective count is the independent oracle for the
+    # static model in comm_summary (which must count nsync + 2)
+    assert n_ar == sched.comm_summary()["solve_syncs"], (
+        n_ar, sched.comm_summary())
 
 
 def test_comm_summary_accounting():
@@ -220,10 +224,11 @@ def test_comm_summary_accounting():
     assert all(v == 0 for v in s1.comm_summary().values())
     s8 = get_schedule(plan, 8)
     cs = s8.comm_summary(np.float32, nrhs=2)
-    nsync = (sum(1 for g in s8.groups if g.fwd_sync)
-             + sum(1 for g in s8.groups if g.bwd_sync) + 2)
-    assert cs["solve_syncs"] == nsync
-    assert cs["solve_sync_bytes"] == nsync * (plan.n + 1) * 2 * 4
+    # interface sanity (the exact sync count is pinned independently
+    # against compiled HLO in test_solve_sync_elision)
+    assert 2 < cs["solve_syncs"] < 2 * len(s8.groups) + 2
+    assert cs["solve_sync_bytes"] == (cs["solve_syncs"]
+                                      * (plan.n + 1) * 2 * 4)
     assert cs["factor_allgather_bytes"] > 0
     assert cs["coop_psum_bytes"] == 0    # no coop at default threshold
 

@@ -1,0 +1,42 @@
+"""Argument-validation and degenerate-input behavior (the reference's
+*info<0 argument checks and info>0 singularity signals, SRC/pdgssvx.c
+docs; exercised here as typed exceptions)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.options import YesNo
+
+
+def test_1x1_matrix():
+    a = slu.csr_from_scipy(sp.csr_matrix(np.array([[4.0]])))
+    x, lu, _ = slu.gssvx(slu.Options(), a, np.array([8.0]))
+    assert np.allclose(x, [2.0])
+
+
+def test_non_square_rejected():
+    a = slu.csr_from_scipy(sp.csr_matrix(np.ones((2, 3))))
+    with pytest.raises(ValueError):
+        slu.gssvx(slu.Options(), a, np.ones(2))
+
+
+def test_wrong_length_rhs_rejected():
+    a = slu.csr_from_scipy(sp.identity(4, format="csr"))
+    with pytest.raises(ValueError):
+        slu.gssvx(slu.Options(), a, np.ones(3))
+
+
+def test_factored_without_lu_rejected():
+    a = slu.csr_from_scipy(sp.identity(4, format="csr"))
+    with pytest.raises(ValueError):
+        slu.gssvx(slu.Options(fact=slu.Fact.FACTORED), a, np.ones(4))
+
+
+def test_empty_row_rejected():
+    a = slu.csr_from_scipy(sp.csr_matrix(np.array([[1.0, 0.0],
+                                                   [0.0, 0.0]])))
+    with pytest.raises(ValueError):
+        slu.gssvx(slu.Options(replace_tiny_pivot=YesNo.NO), a,
+                  np.ones(2))
