@@ -104,7 +104,12 @@ def main():
         k = int(os.environ.get("SLU_BENCH_K", "160"))
         a = laplacian_2d(k)
         desc = f"2D Laplacian n={k * k}"
-    xtrue, b = manufactured_rhs(a)
+    # SLU_BENCH_NRHS>1 covers the many-RHS solve regime (the ldoor
+    # nrhs=64 baseline config)
+    nrhs = int(os.environ.get("SLU_BENCH_NRHS", "1"))
+    xtrue, b = manufactured_rhs(a, nrhs=nrhs)
+    if nrhs > 1:
+        desc += f" nrhs={nrhs}"
 
     # --- baseline: scipy SuperLU (serial CPU, f64) ---
     acsc = a.to_scipy().tocsc()
@@ -121,7 +126,7 @@ def main():
     t_plan = time.perf_counter() - t0
     step = make_fused_solver(plan, dtype="float32")
     vals = jnp.asarray(a.data)
-    bb = jnp.asarray(b[:, None])
+    bb = jnp.asarray(b[:, None] if b.ndim == 1 else b)
 
     t0 = time.perf_counter()
     x, berr, steps, tiny, nzero = step(vals, bb)   # compile + run
@@ -135,7 +140,8 @@ def main():
         x, berr, steps, tiny, nzero = step(vals, bb)
         x.block_until_ready()
         best = min(best, time.perf_counter() - t0)
-    x = np.asarray(x)[:, 0]
+    x = np.asarray(x)
+    x = x[:, 0] if xtrue.ndim == 1 else x
     relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
     accuracy_ok = relerr < 1e-9
 
