@@ -489,9 +489,17 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             Li_cur += n_loc * wb * wb
             Ui_cur += n_loc * wb * wb
 
-    # ea_src pads -> index of the zero slot appended at upd_total
+    # ea_src pads -> index of the zero slot appended at upd_total.
+    # Sort the add-scatter (dst, src) pairs by destination (free on the
+    # host, adds commute): the device scatters can then carry the
+    # indices_are_sorted promise, the parallel-friendly lowering.
     for g in groups:
         g.ea_src[g.ea_src == -1] = upd_peak
+        for dst, src in ((g.ea_dst, g.ea_src), (g.a_dst, g.a_src)):
+            for d in range(dst.shape[0]):
+                o = np.argsort(dst[d], kind="stable")
+                dst[d] = dst[d][o]
+                src[d] = src[d][o]
 
     # gather post-pass, from ACTUAL placements (parents are always
     # scheduled after their children, so sup_dev is complete here): a
@@ -600,6 +608,19 @@ def _flat_axis_index(axis):
     return jax.lax.axis_index(axis)
 
 
+def psum_exact(x, axis):
+    """psum that splits complex operands into real/imag all-reduces.
+
+    Complex all-reduce has shown run-to-run nondeterminism (wrong
+    values/NaN) on the XLA:CPU threaded runtime; the split is bitwise
+    equivalent and deterministic (pinned by
+    tests/test_coop.py::test_complex_dist_solve_deterministic)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return (jax.lax.psum(x.real, axis)
+                + 1j * jax.lax.psum(x.imag, axis)).astype(x.dtype)
+    return jax.lax.psum(x, axis)
+
+
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        tiny, nzero, thresh, a_src, a_dst, one_dst,
                        ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
@@ -611,11 +632,14 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     one = jnp.ones((), dtype)
     F = jnp.zeros(n_pad * mb * mb, dtype)
     # a_dst/one_dst carry DISTINCT out-of-bounds padding, so the
-    # unique-indices promise holds and the scatters lower parallel
+    # unique-indices promise holds; add-scatter index pairs are
+    # dst-sorted by the schedule builder, so they also promise
+    # indices_are_sorted — both enable parallel scatter lowerings
     F = F.at[a_dst].add(vals[a_src], mode="drop",
-                        unique_indices=True)
+                        unique_indices=True, indices_are_sorted=True)
     F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
-    F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop")
+    F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop",
+                         indices_are_sorted=True)
     F = F.reshape(n_pad, mb, mb)
 
     if coop and axis is not None:
@@ -677,39 +701,64 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
 
 
 
+# Sweep storage codec: when the system is complex, X is carried as a
+# REAL array with real/imag planes concatenated along the rhs axis and
+# converted to complex only around the matmuls.  Every solve-sweep
+# gather/scatter/psum then operates on real data — complex
+# gather/scatter in this sweep pattern has shown a per-process
+# miscompile lottery on the forced-multi-device XLA:CPU client (stable
+# wrong single elements; see tests/test_coop.py::
+# test_complex_dist_solve_deterministic).  The factor path keeps
+# complex storage (its ops have never misbehaved).
+
+def _dec(xb, cplx: bool):
+    if not cplx:
+        return xb
+    h = xb.shape[-1] // 2
+    return jax.lax.complex(xb[..., :h], xb[..., h:])
+
+
+def _enc(y, cplx: bool):
+    if not cplx:
+        return y
+    return jnp.concatenate([y.real, y.imag], axis=-1)
+
+
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
-                    Li_off, *, mb: int, wb: int, n_pad: int):
+                    Li_off, *, mb: int, wb: int, n_pad: int,
+                    cplx: bool = False):
     """Device-local sweep step: in distributed mode each device runs
     this on its own X copy (dummy indices elsewhere) and _solve_loop
     reconciles by psum-of-diffs at its static sync points."""
-    xb = X[col_idx]                                     # (Np, wb, nrhs)
+    xb = _dec(X[col_idx], cplx)                         # (Np, wb, nrhs)
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     y = Li @ xb
-    X = X.at[col_idx].set(y)
+    X = X.at[col_idx].set(_enc(y, cplx))
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-        X = X.at[struct_idx].add(-(Lp[:, wb:, :] @ y))
+        X = X.at[struct_idx].add(_enc(-(Lp[:, wb:, :] @ y), cplx))
     return X
 
 
 
 
 def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
-                    Ui_off, *, mb: int, wb: int, n_pad: int):
-    xb = X[col_idx]
+                    Ui_off, *, mb: int, wb: int, n_pad: int,
+                    cplx: bool = False):
+    xb = _dec(X[col_idx], cplx)
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
-        xs = X[struct_idx]
+        xs = _dec(X[struct_idx], cplx)
         rhs = xb - Up[:, :, wb:] @ xs
     else:
         rhs = xb
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     x1 = Ui @ rhs
-    return X.at[col_idx].set(x1)
+    return X.at[col_idx].set(_enc(x1, cplx))
 
 
 
@@ -719,36 +768,38 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
 # on the fly (einsum-transpose is free on the MXU)
 
 def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
-                      Ui_off, *, mb: int, wb: int, n_pad: int):
-    xb = X[col_idx]
+                      Ui_off, *, mb: int, wb: int, n_pad: int,
+                      cplx: bool = False):
+    xb = _dec(X[col_idx], cplx)
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     y = jnp.einsum("nwv,nwr->nvr", Ui, xb)          # Uiᵀ @ xb
-    X = X.at[col_idx].set(y)
+    X = X.at[col_idx].set(_enc(y, cplx))
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
-        X = X.at[struct_idx].add(
-            -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y))
+        X = X.at[struct_idx].add(_enc(
+            -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y), cplx))
     return X
 
 
 
 
 def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
-                      Li_off, *, mb: int, wb: int, n_pad: int):
-    xb = X[col_idx]
+                      Li_off, *, mb: int, wb: int, n_pad: int,
+                      cplx: bool = False):
+    xb = _dec(X[col_idx], cplx)
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-        xs = X[struct_idx]
+        xs = _dec(X[struct_idx], cplx)
         rhs = xb - jnp.einsum("nsw,nsr->nwr", Lp[:, wb:, :], xs)
     else:
         rhs = xb
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
     x1 = jnp.einsum("nwv,nwr->nvr", Li, rhs)        # Liᵀ @ rhs
-    return X.at[col_idx].set(x1)
+    return X.at[col_idx].set(_enc(x1, cplx))
 
 
 
@@ -893,21 +944,25 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         # promote rather than cast: a complex rhs against a real
         # factor must stay complex (matches solve_device)
         xdt = jnp.promote_types(dtype, b.dtype)
+        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
         X = jnp.zeros((sched.n + 1, b.shape[1]), xdt)
         X = X.at[:sched.n, :].set(b.astype(xdt))
+        X = _enc(X, cplx)
         for g in sched.groups:
             _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
             X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
                                 struct_idx, jnp.int32(g.L_off),
                                 jnp.int32(g.Li_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                cplx=cplx)
         for g in reversed(sched.groups):
             _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
             X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
                                 struct_idx, jnp.int32(g.U_off),
                                 jnp.int32(g.Ui_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc)
-        return X[:sched.n]
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                cplx=cplx)
+        return _dec(X, cplx)[:sched.n]
 
     return step
 

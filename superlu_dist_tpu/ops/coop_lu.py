@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .batched import psum_exact as _psum
 from .dense_lu import _newton_tri_inverse, _tiny_replace, _DIAG_UNROLL
 
 
@@ -114,7 +115,7 @@ def _coop_lu_one(F, thresh, *, wb: int, mb: int, mbp: int, cb: int,
         # one panel may straddle an ownership boundary)
         panel = jax.lax.dynamic_slice(F, (0, k0), (mb, pb))
         own = (k0 + cols_pb) // cb == dev
-        panel = jax.lax.psum(jnp.where(own, panel, 0), axis)
+        panel = _psum(jnp.where(own, panel, 0), axis)
         panel, t_g, z_g = _panel_eliminate(panel, k0, thresh,
                                            pb=pb, mb=mb)
         tiny, nzero = tiny + t_g, nzero + z_g
@@ -148,7 +149,7 @@ def _coop_lu_one(F, thresh, *, wb: int, mb: int, mbp: int, cb: int,
     # block, the panel columns would be all-reduced zeros
     if wb < mbp:
         mine_t = colg[:, wb:] // cb == dev
-        trail = jax.lax.psum(jnp.where(mine_t, F[:, wb:], 0), axis)
+        trail = _psum(jnp.where(mine_t, F[:, wb:], 0), axis)
         F = jnp.concatenate([F[:, :wb], trail], axis=1)
     return F, tiny, nzero
 

@@ -48,8 +48,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..plan.plan import FactorPlan
-from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl,
-                           _factor_group_impl, _fwd_group_impl,
+from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl, _dec,
+                           _enc, _factor_group_impl, _fwd_group_impl,
                            _fwd_group_T_impl, _hi_prec, _real_dtype,
                            _thresh_for, get_schedule)
 
@@ -114,8 +114,12 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
     L_flat, U_flat, Li_flat, Ui_flat = flats
     n = dsched.n
     xdt = jnp.promote_types(dtype, b.dtype)
+    cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
     X = jnp.zeros((n + 1, b.shape[1]), xdt)
     X = X.at[:n, :].set(b.astype(xdt))
+    # complex systems sweep on the real-view storage (see the codec
+    # note at batched._dec): gathers/scatters/psums stay real
+    X = _enc(X, cplx)
     Xs = X                       # last reconciled snapshot (axis mode)
 
     def sync(X, Xs):
@@ -137,7 +141,7 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
         if axis is not None and g.fwd_sync:
             X, Xs = sync(X, Xs)
         X = fwd_fn(X, *fwd_flats, ci, si, *fwd_offs(g),
-                   mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                   mb=g.mb, wb=g.wb, n_pad=g.n_loc, cplx=cplx)
     if axis is not None:
         X, Xs = sync(X, Xs)      # complete forward solution
     for g, (ci, si) in zip(reversed(dsched.groups),
@@ -145,10 +149,10 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
         if axis is not None and g.bwd_sync:
             X, Xs = sync(X, Xs)
         X = bwd_fn(X, *bwd_flats, ci, si, *bwd_offs(g),
-                   mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                   mb=g.mb, wb=g.wb, n_pad=g.n_loc, cplx=cplx)
     if axis is not None:
         X, _ = sync(X, Xs)       # replicate the final solution
-    return X[:n]
+    return _dec(X, cplx)[:n]
 
 
 def _group_operands(dsched, fields):

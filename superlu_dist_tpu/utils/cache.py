@@ -18,15 +18,29 @@ import re
 
 
 def host_cache_dir(base: str) -> str:
-    """`base` extended with a stable fingerprint of this host's CPU."""
-    key = ""
+    """`base` extended with a stable fingerprint of this host's CPU.
+
+    The fingerprint must include the CPU MODEL IDENTITY, not just the
+    feature flags: XLA derives extra target features from the detected
+    model (e.g. +prefer-no-scatter on some microarchitectures), so two
+    hosts with identical cpuinfo flags can still produce mutually
+    unloadable (or worse, silently wrong) AOT objects."""
+    parts = []
     try:
         with open("/proc/cpuinfo") as f:
-            m = re.search(r"^flags\s*:\s*(.*)$", f.read(), re.M)
-        if m:
-            key = " ".join(sorted(m.group(1).split()))
+            head = f.read().split("\n\n", 1)[0]
+        for field in ("vendor_id", "cpu family", "model", "stepping",
+                      "model name", "flags"):
+            m = re.search(rf"^{re.escape(field)}\s*:\s*(.*)$", head,
+                          re.M)
+            if m:
+                v = m.group(1)
+                if field == "flags":
+                    v = " ".join(sorted(v.split()))
+                parts.append(f"{field}={v}")
     except OSError:
         pass
-    if not key:
-        key = f"{platform.machine()}-{platform.processor()}"
+    if not parts:
+        parts = [platform.machine(), platform.processor()]
+    key = "|".join(parts)
     return f"{base}-{hashlib.sha1(key.encode()).hexdigest()[:12]}"

@@ -159,3 +159,31 @@ def test_coop_mesh_shape_invariance(force_coop):
             ref = x
         else:
             assert np.allclose(x, ref, atol=1e-10)
+
+
+def test_complex_dist_solve_deterministic(force_coop):
+    """Run-to-run determinism of the complex dist solve (regression:
+    complex all-reduce on the XLA:CPU threaded runtime intermittently
+    produced wrong values/NaN; psum_exact splits real/imag planes)."""
+    from superlu_dist_tpu.parallel.factor_dist import (make_dist_factor,
+                                                       make_dist_solve)
+
+    a, A, xtrue, b = _problem(24, complex_=True)
+    plan = plan_factorization(a, Options())
+    vals = plan.scaled_values(a.data)
+    bf = jnp.asarray(b[plan.final_row])
+    g = make_solver_mesh(2, 2, 2)
+    dlu = make_dist_factor(plan, g.mesh,
+                           dtype=np.complex128)(jnp.asarray(vals))
+    solve = make_dist_solve(plan, g.mesh, dtype=np.complex128)
+    lu1 = factorize_device(plan, vals, dtype=np.complex128)
+    x1 = solve_device(lu1, np.asarray(bf))
+    x0 = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                          dlu.Ui_flat, bf))
+    assert np.allclose(x0, x1, atol=1e-10), \
+        f"max diff {np.abs(x0 - x1).max():.3e}"
+    for _ in range(10):
+        x = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                             dlu.Ui_flat, bf))
+        assert np.array_equal(x, x0), \
+            f"nondeterministic solve: {np.abs(x - x0).max():.3e}"
