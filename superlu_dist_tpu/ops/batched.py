@@ -168,7 +168,15 @@ class BatchedSchedule:
         """Static per-step collective traffic (the SCT_t comm-volume
         counters, SRC/util_dist.h:194-317, computed from the schedule
         instead of measured): words moved by factor all_gathers, coop
-        panel/trailing psums, and solve sync psums."""
+        panel/trailing psums, and solve sync psums.
+
+        Counting conventions: each coop psum counts as ONE collective
+        here, but complex factor dtypes execute it as TWO real
+        all-reduces (psum_exact splits real/imag) — the *byte* totals
+        coincide, the collective count understates by 2x for c64/c128.
+        solve_sync_bytes is sized by the caller-passed dtype; the sweep
+        actually moves the real-view-encoded X, which is again
+        byte-identical for complex."""
         it = np.dtype(dtype).itemsize
         gather_b = sum(g.n_loc * self.ndev * (g.mb - g.wb) ** 2 * it
                        for g in self.groups
@@ -702,14 +710,19 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
 
 
 # Sweep storage codec: when the system is complex, X is carried as a
-# REAL array with real/imag planes concatenated along the rhs axis and
-# converted to complex only around the matmuls.  Every solve-sweep
-# gather/scatter/psum then operates on real data — complex
-# gather/scatter in this sweep pattern has shown a per-process
-# miscompile lottery on the forced-multi-device XLA:CPU client (stable
-# wrong single elements; see tests/test_coop.py::
-# test_complex_dist_solve_deterministic).  The factor path keeps
-# complex storage (its ops have never misbehaved).
+# REAL array with real/imag planes concatenated along the rhs axis,
+# and the sweep matmuls contract the panel's real and imaginary parts
+# against that encoding separately — the triangular sweeps execute NO
+# complex arithmetic at all.  Complex gather/scatter in this sweep
+# pattern has shown a per-process miscompile lottery on the
+# forced-multi-device XLA:CPU client (stable wrong single elements;
+# see tests/test_coop.py::test_complex_dist_solve_deterministic), and
+# complex einsums in the transpose sweep showed the same
+# order-dependent lottery under the full-suite compile mix (round-1
+# test_trans_complex flake) — so both are kept out of the sweeps
+# entirely.  Cost is nil: a complex matmul IS four real matmuls; this
+# just writes them explicitly.  The factor path keeps complex storage
+# (its ops have never misbehaved).
 
 def _dec(xb, cplx: bool):
     if not cplx:
@@ -724,21 +737,37 @@ def _enc(y, cplx: bool):
     return jnp.concatenate([y.real, y.imag], axis=-1)
 
 
+def _mm_enc(sub: str, A, xe, cplx: bool):
+    """einsum(sub, A, x) where x is real-view encoded (real/imag
+    halves concatenated along the last axis); returns the encoded
+    product.  Real A (real factor, complex rhs) contracts both halves
+    in one einsum; complex A splits into real/imag contractions:
+    (Ar + i·Ai)(xr + i·xi) = (Ar·xr − Ai·xi) + i·(Ar·xi + Ai·xr)."""
+    if not cplx or not jnp.issubdtype(A.dtype, jnp.complexfloating):
+        return jnp.einsum(sub, A, xe)
+    h = xe.shape[-1] // 2
+    er = jnp.einsum(sub, A.real, xe)
+    ei = jnp.einsum(sub, A.imag, xe)
+    return jnp.concatenate([er[..., :h] - ei[..., h:],
+                            er[..., h:] + ei[..., :h]], axis=-1)
+
+
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
                     Li_off, *, mb: int, wb: int, n_pad: int,
                     cplx: bool = False):
     """Device-local sweep step: in distributed mode each device runs
     this on its own X copy (dummy indices elsewhere) and _solve_loop
     reconciles by psum-of-diffs at its static sync points."""
-    xb = _dec(X[col_idx], cplx)                         # (Np, wb, nrhs)
+    xb = X[col_idx]                                     # (Np, wb, R̂)
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
-    y = Li @ xb
-    X = X.at[col_idx].set(_enc(y, cplx))
+    y = _mm_enc("nvw,nwr->nvr", Li, xb, cplx)           # Li @ xb
+    X = X.at[col_idx].set(y)
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-        X = X.at[struct_idx].add(_enc(-(Lp[:, wb:, :] @ y), cplx))
+        X = X.at[struct_idx].add(
+            -_mm_enc("nsw,nwr->nsr", Lp[:, wb:, :], y, cplx))
     return X
 
 
@@ -747,18 +776,18 @@ def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
 def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
                     Ui_off, *, mb: int, wb: int, n_pad: int,
                     cplx: bool = False):
-    xb = _dec(X[col_idx], cplx)
+    xb = X[col_idx]
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
-        xs = _dec(X[struct_idx], cplx)
-        rhs = xb - Up[:, :, wb:] @ xs
+        xs = X[struct_idx]
+        rhs = xb - _mm_enc("nws,nsr->nwr", Up[:, :, wb:], xs, cplx)
     else:
         rhs = xb
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
-    x1 = Ui @ rhs
-    return X.at[col_idx].set(_enc(x1, cplx))
+    x1 = _mm_enc("nvw,nwr->nvr", Ui, rhs, cplx)
+    return X.at[col_idx].set(x1)
 
 
 
@@ -770,16 +799,16 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
 def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
                       Ui_off, *, mb: int, wb: int, n_pad: int,
                       cplx: bool = False):
-    xb = _dec(X[col_idx], cplx)
+    xb = X[col_idx]
     Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
-    y = jnp.einsum("nwv,nwr->nvr", Ui, xb)          # Uiᵀ @ xb
-    X = X.at[col_idx].set(_enc(y, cplx))
+    y = _mm_enc("nwv,nwr->nvr", Ui, xb, cplx)       # Uiᵀ @ xb
+    X = X.at[col_idx].set(y)
     if mb > wb:
         Up = jax.lax.dynamic_slice(
             U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
-        X = X.at[struct_idx].add(_enc(
-            -jnp.einsum("nws,nwr->nsr", Up[:, :, wb:], y), cplx))
+        X = X.at[struct_idx].add(
+            -_mm_enc("nws,nwr->nsr", Up[:, :, wb:], y, cplx))
     return X
 
 
@@ -788,18 +817,18 @@ def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
 def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
                       Li_off, *, mb: int, wb: int, n_pad: int,
                       cplx: bool = False):
-    xb = _dec(X[col_idx], cplx)
+    xb = X[col_idx]
     if mb > wb:
         Lp = jax.lax.dynamic_slice(
             L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
-        xs = _dec(X[struct_idx], cplx)
-        rhs = xb - jnp.einsum("nsw,nsr->nwr", Lp[:, wb:, :], xs)
+        xs = X[struct_idx]
+        rhs = xb - _mm_enc("nsw,nsr->nwr", Lp[:, wb:, :], xs, cplx)
     else:
         rhs = xb
     Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
                                (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
-    x1 = jnp.einsum("nwv,nwr->nvr", Li, rhs)        # Liᵀ @ rhs
-    return X.at[col_idx].set(_enc(x1, cplx))
+    x1 = _mm_enc("nwv,nwr->nvr", Li, rhs, cplx)     # Liᵀ @ rhs
+    return X.at[col_idx].set(x1)
 
 
 
