@@ -22,10 +22,12 @@
 // 64 mode, SRC/superlu_defs.h).
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <limits>
+#include <numeric>
 #include <queue>
 #include <thread>
 #include <vector>
@@ -412,6 +414,254 @@ int64_t slu_mc64(int64_t n, const int64_t* colptr, const int64_t* rowind,
       if (j == -1) continue;
       for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
         if (rowind[p] == i2) { v[j] = w[p] - u[i2]; break; }
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) rowperm[i] = match_row[i];
+  return 0;
+}
+
+// ---------------------------------------------------------------- hwpm
+// Approximate heavy-weight perfect matching — the parallel
+// LargeDiag_HWPM slot (reference SRC/d_c2cpp_GetHWPM.cpp →
+// dHWPM_CombBLAS.hpp:60, which delegates to CombBLAS's distributed
+// AWPM).  Shared-memory redesign, not a port:
+//
+//   1. locally-dominant parallel greedy matching on the weights
+//      w(i,j) = log|a_ij| − log cmax_j: threaded rounds where every
+//      free row proposes its best still-free column and each column
+//      atomically accepts the heaviest proposal (a ≥1/2-approximation
+//      of the maximum-weight matching, like AWPM's dominant-edge
+//      phase);
+//   2. completion to a PERFECT matching by augmenting paths over the
+//      pattern, trying heavy edges first (HWPM also trades diagonal
+//      weight for perfection — static pivoting needs a structurally
+//      full diagonal above all).
+//
+// Produces the permutation only — no dual scalings — matching the
+// reference HWPM contract (MC64 job=5 is the scaling-producing path).
+// Exact zeros are treated as structurally absent, as in slu_mc64.
+// nthreads ≤ 0 → hardware concurrency.  Returns 0, or -1 when no
+// perfect matching exists (structurally singular).
+int64_t slu_hwpm(int64_t n, const int64_t* colptr, const int64_t* rowind,
+                 const double* absval, int64_t nthreads,
+                 int64_t* rowperm) {
+  const double NEG_INF = -std::numeric_limits<double>::infinity();
+  const int64_t nnz = colptr[n];
+  // the proposal key packs the row id into 32 bits; beyond that the
+  // accept phase would decode the wrong row (caller falls back to the
+  // exact matching — unreachable in practice)
+  if (n >= ((int64_t)1 << 32)) return -2;
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int64_t)hc : 1;
+  }
+  if (n < (int64_t)1 << 13) nthreads = 1;  // thread spawn not worth it
+
+  std::vector<double> cmax(n, 0.0);
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p)
+      if (absval[p] > cmax[j]) cmax[j] = absval[p];
+  for (int64_t j = 0; j < n; ++j)
+    if (cmax[j] <= 0.0) return -1;  // structurally empty column
+
+  // row-major adjacency (transpose of the CSC input) with weights
+  std::vector<int64_t> rptr(n + 1, 0), rcol(nnz);
+  std::vector<double> rw(nnz);
+  for (int64_t p = 0; p < nnz; ++p) rptr[rowind[p] + 1]++;
+  for (int64_t i = 0; i < n; ++i) rptr[i + 1] += rptr[i];
+  {
+    std::vector<int64_t> cur(rptr.begin(), rptr.end() - 1);
+    for (int64_t j = 0; j < n; ++j) {
+      double lc = std::log(cmax[j]);
+      for (int64_t p = colptr[j]; p < colptr[j + 1]; ++p) {
+        int64_t i = rowind[p], q = cur[i]++;
+        rcol[q] = j;
+        rw[q] = absval[p] > 0.0 ? std::log(absval[p]) - lc : NEG_INF;
+      }
+    }
+  }
+
+  // per-row candidates sorted heaviest-first (embarrassingly parallel)
+  auto sort_span = [&](int64_t lo, int64_t hi) {
+    std::vector<int64_t> ord;
+    std::vector<int64_t> tc;
+    std::vector<double> tw;
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t b = rptr[i], e = rptr[i + 1], m = e - b;
+      if (m <= 1) continue;
+      ord.resize(m);
+      std::iota(ord.begin(), ord.end(), (int64_t)0);
+      std::sort(ord.begin(), ord.end(), [&](int64_t x, int64_t y) {
+        return rw[b + x] > rw[b + y];
+      });
+      tc.assign(rcol.begin() + b, rcol.begin() + e);
+      tw.assign(rw.begin() + b, rw.begin() + e);
+      for (int64_t k = 0; k < m; ++k) {
+        rcol[b + k] = tc[ord[k]];
+        rw[b + k] = tw[ord[k]];
+      }
+    }
+  };
+  if (nthreads > 1) {
+    std::vector<std::thread> ts;
+    int64_t chunk = (n + nthreads - 1) / nthreads;
+    for (int64_t t = 0; t < nthreads; ++t)
+      ts.emplace_back(sort_span, t * chunk,
+                      std::min(n, (t + 1) * chunk));
+    for (auto& t : ts) t.join();
+  } else {
+    sort_span(0, n);
+  }
+
+  // ---- phase 1: locally-dominant greedy (propose / accept rounds)
+  // proposal key packs (order-preserving f32 of the weight, ~row) so
+  // one 64-bit CAS-max resolves "heaviest proposal wins, smallest row
+  // breaks ties"; f32 rounding only blurs near-equal-weight ties,
+  // fine for an approximate matching.
+  auto prop_key = [](double wgt, int64_t row) -> uint64_t {
+    float f = (float)wgt;
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    bits = (bits & 0x80000000u) ? ~bits : (bits | 0x80000000u);
+    return ((uint64_t)bits << 32) | (uint32_t)(~(uint32_t)row);
+  };
+  std::vector<int64_t> match_row(n, -1), match_col(n, -1);
+  std::vector<int64_t> ptr(rptr.begin(), rptr.end() - 1);
+  std::vector<std::atomic<uint64_t>> best(n);
+  for (auto& b : best) b.store(0, std::memory_order_relaxed);
+  std::vector<int64_t> frees(n);
+  std::iota(frees.begin(), frees.end(), (int64_t)0);
+  std::vector<int64_t> touched;  // columns proposed this round
+
+  while (!frees.empty()) {
+    touched.clear();
+    // propose (parallel over free rows)
+    std::atomic<int64_t> widx{0};
+    std::vector<std::vector<int64_t>> touched_t(nthreads);
+    auto propose = [&](int64_t t) {
+      int64_t i;
+      while ((i = widx.fetch_add(1)) < (int64_t)frees.size()) {
+        int64_t r = frees[i];
+        int64_t e = rptr[r + 1];
+        while (ptr[r] < e && (match_col[rcol[ptr[r]]] != -1 ||
+                              rw[ptr[r]] == NEG_INF))
+          ++ptr[r];
+        if (ptr[r] >= e) continue;  // exhausted: completion phase
+        int64_t j = rcol[ptr[r]];
+        uint64_t key = prop_key(rw[ptr[r]], r);
+        uint64_t cur = best[j].load(std::memory_order_relaxed);
+        bool first = (cur == 0);
+        while (cur < key && !best[j].compare_exchange_weak(
+                   cur, key, std::memory_order_relaxed)) {}
+        if (first) touched_t[t].push_back(j);
+      }
+    };
+    if (nthreads > 1) {
+      std::vector<std::thread> ts;
+      for (int64_t t = 0; t < nthreads; ++t)
+        ts.emplace_back(propose, t);
+      for (auto& t : ts) t.join();
+    } else {
+      propose(0);
+    }
+    // accept: the winning row of each touched column matches it
+    bool any = false;
+    std::vector<int64_t> next_free;
+    next_free.reserve(frees.size());
+    for (auto& tt : touched_t)
+      for (int64_t j : tt) touched.push_back(j);
+    for (int64_t j : touched) {
+      uint64_t key = best[j].exchange(0, std::memory_order_relaxed);
+      if (key == 0 || match_col[j] != -1) continue;
+      int64_t r = (int64_t)(uint32_t)~((uint32_t)(key & 0xffffffffu));
+      if (match_row[r] != -1) continue;
+      match_row[r] = j;
+      match_col[j] = r;
+      any = true;
+    }
+    for (int64_t r : frees)
+      if (match_row[r] == -1 && ptr[r] < rptr[r + 1])
+        next_free.push_back(r);
+    frees.swap(next_free);
+    if (!any && !frees.empty()) {
+      // every remaining proposal lost to an already-matched column;
+      // pointers advanced, so progress continues — but guard against
+      // a stall where all rows are exhausted
+      bool progress = false;
+      for (int64_t r : frees)
+        if (ptr[r] < rptr[r + 1]) { progress = true; break; }
+      if (!progress) break;
+    }
+  }
+
+  // ---- phase 2: completion to a perfect matching by Hopcroft–Karp
+  // (BFS-layered phases of vertex-disjoint shortest augmenting paths,
+  // O(E·√V); heavy edges are still tried first within a layer thanks
+  // to the candidate sort).  Augmentation may rotate some greedy
+  // pairs — perfection over weight, the same trade the reference's
+  // HWPM completion makes (static pivoting needs a structurally full
+  // diagonal above all).
+  const int64_t INF64 = std::numeric_limits<int64_t>::max();
+  std::vector<int64_t> dist(n), bfs_q(n), stk_row;
+  std::vector<int64_t> dfs_ptr(n);
+  while (true) {
+    // BFS from all free rows over alternating edges
+    int64_t qh = 0, qt = 0;
+    std::fill(dist.begin(), dist.end(), INF64);
+    for (int64_t r = 0; r < n; ++r)
+      if (match_row[r] == -1) {
+        dist[r] = 0;
+        bfs_q[qt++] = r;
+      }
+    if (qt == 0) break;  // already perfect
+    bool reachable = false;
+    while (qh < qt) {
+      int64_t r = bfs_q[qh++];
+      for (int64_t p = rptr[r]; p < rptr[r + 1]; ++p) {
+        if (rw[p] == NEG_INF) continue;
+        int64_t r2 = match_col[rcol[p]];
+        if (r2 == -1) {
+          reachable = true;
+        } else if (dist[r2] == INF64) {
+          dist[r2] = dist[r] + 1;
+          bfs_q[qt++] = r2;
+        }
+      }
+    }
+    if (!reachable) return -1;  // free rows but no augmenting path
+    // layered DFS: vertex-disjoint augmenting paths
+    std::copy(rptr.begin(), rptr.end() - 1, dfs_ptr.begin());
+    for (int64_t r0 = 0; r0 < n; ++r0) {
+      if (match_row[r0] != -1) continue;
+      stk_row.assign(1, r0);
+      while (!stk_row.empty()) {
+        int64_t r = stk_row.back();
+        int64_t& p = dfs_ptr[r];
+        if (p >= rptr[r + 1]) {
+          dist[r] = INF64;  // dead end: prune for this phase
+          stk_row.pop_back();
+          continue;
+        }
+        int64_t q = p++;
+        if (rw[q] == NEG_INF) continue;
+        int64_t j = rcol[q];
+        int64_t r2 = match_col[j];
+        if (r2 == -1) {
+          // augment along the stack: stack rows are the path
+          int64_t jj = j;
+          for (int64_t d = (int64_t)stk_row.size() - 1; d >= 0; --d) {
+            int64_t rr = stk_row[d];
+            int64_t prevj = match_row[rr];
+            match_row[rr] = jj;
+            match_col[jj] = rr;
+            jj = prevj;
+          }
+          for (int64_t rr : stk_row) dist[rr] = INF64;  // used up
+          stk_row.clear();
+        } else if (dist[r2] == dist[r] + 1) {
+          stk_row.push_back(r2);
+        }
+      }
     }
   }
   for (int64_t i = 0; i < n; ++i) rowperm[i] = match_row[i];
@@ -823,6 +1073,6 @@ void slu_symbfact_free(void* handle) {
   delete static_cast<SymbHandle*>(handle);
 }
 
-int64_t slu_version() { return 4; }
+int64_t slu_version() { return 5; }
 
 }  // extern "C"

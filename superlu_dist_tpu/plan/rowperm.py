@@ -22,24 +22,34 @@ from ..options import RowPerm
 from ..sparse import CSRMatrix
 
 
-def large_diag_perm(a: CSRMatrix) -> np.ndarray:
-    """Return perm_r with perm_r[i] = new position of row i, such that
-    (Pr·A) has a structurally perfect, product-maximal diagonal.
-    Dispatches to the native C++ MC64 (csrc/slu_host.cpp slu_mc64, the
-    shortest-augmenting-path Duff–Koster algorithm); scipy fallback."""
+def _native_matching(a: CSRMatrix, run):
+    """Shared native-dispatch shell for the matching family: CSC
+    conversion + int64 casts + singular-error re-wrap.  `run(native,
+    n, indptr, indices, absval)` returns perm_r or None to decline
+    (then the scipy exact matching runs)."""
     from ..utils.native import native_or_none
     native = native_or_none()
     if native is not None and a.m == a.n:
         acsc = a.to_scipy().tocsc()
         acsc.sort_indices()
         try:
-            perm_r, _, _ = native.mc64(
-                a.n, acsc.indptr.astype(np.int64),
-                acsc.indices.astype(np.int64), np.abs(acsc.data))
-            return perm_r
+            perm_r = run(native, a.n, acsc.indptr.astype(np.int64),
+                         acsc.indices.astype(np.int64),
+                         np.abs(acsc.data))
+            if perm_r is not None:
+                return perm_r
         except ValueError as e:
             raise ValueError(f"structurally singular matrix: {e}") from e
     return large_diag_perm_py(a)
+
+
+def large_diag_perm(a: CSRMatrix) -> np.ndarray:
+    """Return perm_r with perm_r[i] = new position of row i, such that
+    (Pr·A) has a structurally perfect, product-maximal diagonal.
+    Dispatches to the native C++ MC64 (csrc/slu_host.cpp slu_mc64, the
+    shortest-augmenting-path Duff–Koster algorithm); scipy fallback."""
+    return _native_matching(
+        a, lambda nat, n, ip, ix, av: nat.mc64(n, ip, ix, av)[0])
 
 
 def large_diag_perm_py(a: CSRMatrix) -> np.ndarray:
@@ -69,6 +79,27 @@ def large_diag_perm_py(a: CSRMatrix) -> np.ndarray:
     return perm_r
 
 
+def large_diag_perm_hwpm(a: CSRMatrix) -> np.ndarray:
+    """Approximate heavy-weight perfect matching — the parallel
+    LargeDiag_HWPM slot (SRC/d_c2cpp_GetHWPM.cpp →
+    dHWPM_CombBLAS.hpp:60).  Trades exactness of the diagonal product
+    for near-linear parallel time: a threaded locally-dominant greedy
+    matching (≥1/2-approximation) completed to a perfect matching by
+    augmenting paths (csrc/slu_host.cpp slu_hwpm).  The GESP contract
+    (structurally full diagonal, large entries favored) holds; residual
+    quality after equilibration + iterative refinement matches MC64 on
+    the reference test matrices (tests/test_rowperm_hwpm.py).  Falls
+    back to the exact matching when the native library is unavailable
+    or n exceeds the proposal-key packing limit (quality superset,
+    same contract)."""
+    def run(nat, n, ip, ix, av):
+        try:
+            return nat.hwpm(n, ip, ix, av)
+        except OverflowError:
+            return None  # n ≥ 2^32: decline to the exact matching
+    return _native_matching(a, run)
+
+
 def get_perm_r(a: CSRMatrix, mode: RowPerm,
                user_perm_r: np.ndarray | None = None) -> np.ndarray:
     if mode == RowPerm.NOROWPERM:
@@ -77,7 +108,8 @@ def get_perm_r(a: CSRMatrix, mode: RowPerm,
         if user_perm_r is None:
             raise ValueError("RowPerm.MY_PERMR requires user_perm_r")
         return np.asarray(user_perm_r, dtype=np.int64)
-    # LARGE_DIAG_MC64 and LARGE_DIAG_HWPM both map to the matching;
-    # the reference's distinction is serial-vs-parallel execution
-    # (SRC/pdgssvx.c:815,919), not a different mathematical object.
+    if mode == RowPerm.LARGE_DIAG_HWPM:
+        # the parallel approximate-matching escape hatch for the
+        # serial-MC64 scalability cliff (SURVEY.md §7 hard part #5)
+        return large_diag_perm_hwpm(a)
     return large_diag_perm(a)

@@ -87,6 +87,9 @@ def _load():
             lib.slu_mc64.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
                                      _I64, _F64, _F64]
             lib.slu_mc64.restype = ctypes.c_int64
+            lib.slu_hwpm.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
+                                     ctypes.c_int64, _I64]
+            lib.slu_hwpm.restype = ctypes.c_int64
             lib.slu_symbfact_create.argtypes = [
                 ctypes.c_int64, _I64, _I64, ctypes.c_int64, _I64, _I64]
             lib.slu_symbfact_create.restype = ctypes.c_void_p
@@ -109,7 +112,7 @@ def _load():
                                            _I64]
             lib.slu_supernodes.restype = ctypes.c_int64
             lib.slu_version.restype = ctypes.c_int64
-            assert lib.slu_version() == 4
+            assert lib.slu_version() == 5
             _lib = lib
         except (OSError, AssertionError, AttributeError):
             _failed = True
@@ -199,6 +202,28 @@ def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
     if rc != 0:
         raise ValueError("structurally singular matrix (native mc64)")
     return perm, u, v
+
+
+def hwpm(n: int, colptr: np.ndarray, rowind: np.ndarray,
+         absval: np.ndarray, threads: int = 0):
+    """Approximate heavy-weight perfect matching on CSC input (the
+    LargeDiag_HWPM slot, SRC/dHWPM_CombBLAS.hpp:60 analog): parallel
+    locally-dominant greedy + augmenting-path completion.  Returns
+    rowperm only — no dual scalings, matching the reference HWPM
+    contract.  threads=0 → hardware concurrency."""
+    lib = _load()
+    a_pc, pc = _c64(colptr)
+    a_pr, pr = _c64(rowind)
+    a_pv, pv = _cf64(absval)
+    perm = np.empty(n, dtype=np.int64)
+    rc = lib.slu_hwpm(n, pc, pr, pv, threads,
+                      perm.ctypes.data_as(_I64))
+    if rc == -2:
+        raise OverflowError("n exceeds the 2^32 row-id packing limit "
+                            "of the hwpm proposal key")
+    if rc != 0:
+        raise ValueError("structurally singular matrix (native hwpm)")
+    return perm
 
 
 def nd_order(indptr: np.ndarray, indices: np.ndarray, n: int,
