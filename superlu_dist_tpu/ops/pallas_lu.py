@@ -202,6 +202,15 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
     # the deferred compile).
     import sys
     if sys.getrecursionlimit() < 20000:
+        # process-global on purpose (see comment above); reached only
+        # when a Pallas kernel is actually being built, and logged once
+        # so the side effect is discoverable
+        import warnings
+        warnings.warn(
+            "superlu_dist_tpu.ops.pallas_lu: raising "
+            f"sys.setrecursionlimit({sys.getrecursionlimit()} -> 20000) "
+            "for deferred Mosaic lowering of the unrolled block chain",
+            stacklevel=2)
         sys.setrecursionlimit(20000)
     out, tiny, nzero = pl.pallas_call(
         kern,

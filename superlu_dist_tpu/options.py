@@ -95,18 +95,22 @@ class Options:
     replace_tiny_pivot: YesNo = YesNo.YES
     iter_refine: IterRefine = IterRefine.SLU_DOUBLE
     trans: Trans = Trans.NOTRANS
-    solve_initialized: YesNo = YesNo.NO
-    refact_initialized: YesNo = YesNo.NO
     print_stat: YesNo = YesNo.NO
+    # NOTE: the reference's SOLVEstruct bookkeeping flags
+    # (options->SolveInitialized / RefineInitialized,
+    # SRC/superlu_defs.h:737-738) have no analog here on purpose: solve
+    # setup is a jitted program cached per (schedule, dtype, trans) —
+    # reuse is automatic, there is no user-visible init state to track.
+    # Likewise num_lookaheads (SRC/util.c:221): look-ahead is a manual
+    # software pipeline over MPI; under XLA the whole level DAG is one
+    # program and overlap is the compiler's latency-hiding scheduler's
+    # job, so a depth knob would be read by nothing.
 
     # --- supernode / scheduling tunables (sp_ienv_dist analogs) ---
     # sp_ienv(2): relaxed-supernode max size (SRC/sp_ienv.c, SUPERLU_RELAX)
     relax: int = dataclasses.field(default_factory=lambda: _env_int("SUPERLU_RELAX", 32))
     # sp_ienv(3): maximum supernode width (SUPERLU_MAXSUP; MAX_SUPER_SIZE=512)
     max_super: int = dataclasses.field(default_factory=lambda: _env_int("SUPERLU_MAXSUP", 128))
-    # look-ahead window depth (num_lookaheads=10 in the reference; on TPU
-    # this controls cross-level pipelining of panel collectives)
-    num_lookaheads: int = 10
     # supernode amalgamation (plan/symbolic.py amalgamate): merge
     # contiguous parent/child supernodes while total true flops grow at
     # most (1+amalg_tau)×; fewer, bigger fronts trade cheap MXU flops

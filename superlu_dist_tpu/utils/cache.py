@@ -41,6 +41,13 @@ def host_cache_dir(base: str) -> str:
     except OSError:
         pass
     if not parts:
-        parts = [platform.machine(), platform.processor()]
+        # /proc/cpuinfo absent (macOS, some containers): machine() +
+        # processor() alone collide across x86 microarchitectures —
+        # exactly the cross-model AOT misload this module exists to
+        # prevent — so mix in the full platform string (OS release +
+        # version) to at least separate host images; still weaker than
+        # the flags fingerprint, hence kept as last resort only.
+        parts = [platform.machine(), platform.processor(),
+                 platform.platform()]
     key = "|".join(parts)
     return f"{base}-{hashlib.sha1(key.encode()).hexdigest()[:12]}"
