@@ -205,26 +205,6 @@ def main():
 
     r = _run_config(a, desc, nrhs, jnp)
 
-    if os.environ.get("SLU_BENCH_SWEEP") == "1":
-        sweep = [r]
-        extras = [(laplacian_3d(64), "3D Laplacian n=262144", 1)]
-        if nrhs != 64:  # skip if the primary already covered nrhs=64
-            extras.insert(0, (a, desc, 64))          # many-RHS regime
-        for a2, d2, nr2 in extras:
-            try:
-                sweep.append(_run_config(a2, d2, nr2, jnp))
-            except Exception as e:
-                sweep.append(dict(desc=d2, error=repr(e)))
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_SWEEP.jsonl")
-        with open(path, "a") as f:
-            for rec in sweep:
-                rec = dict(rec, platform=dev.platform,
-                           device_kind=getattr(dev, "device_kind", ""),
-                           cpu_fallback=cpu_fallback,
-                           ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
-                f.write(json.dumps(rec) + "\n")
-
     mfu_txt = ""
     if peak_tf > 0:
         mfu = r["gflops"] / (peak_tf * 1e3) * 100.0
@@ -248,6 +228,32 @@ def main():
         "cpu_fallback": cpu_fallback,
     }))
     sys.stdout.flush()
+
+    if os.environ.get("SLU_BENCH_SWEEP") == "1":
+        # secondary configs run AFTER the primary stdout line is out —
+        # a sweep hang/OOM must not cost the contract line — and each
+        # record is appended as soon as it exists
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SWEEP.jsonl")
+
+        def emit(rec):
+            rec = dict(rec, platform=dev.platform,
+                       device_kind=getattr(dev, "device_kind", ""),
+                       cpu_fallback=cpu_fallback,
+                       ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+        emit(r)
+        extras = [(laplacian_3d(64), "3D Laplacian n=262144", 1)]
+        if nrhs != 64:  # skip if the primary already covered nrhs=64
+            extras.insert(0, (a, desc, 64))          # many-RHS regime
+        for a2, d2, nr2 in extras:
+            try:
+                emit(_run_config(a2, d2, nr2, jnp))
+            except Exception as e:
+                emit(dict(desc=d2, error=repr(e)))
+
     if not r["accuracy_ok"]:
         # the JSON line is printed either way, but an accuracy
         # regression must still fail the process for exit-code gates

@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the solve "
                         "into DIR (the PROFlevel/VTune-hook analog; "
                         "view with tensorboard or xprof)")
+    p.add_argument("--stats", action="store_true",
+                   help="also print measured collective traffic from "
+                        "the compiled HLO next to the schedule's "
+                        "prediction (SCT_print3D analog; distributed "
+                        "runs only)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="echo the effective options "
@@ -170,7 +175,11 @@ def _solve_distributed(a, b, opts, args, stats):
     from ..parallel.grid import make_solver_mesh
 
     g = make_solver_mesh(args.nprow, args.npcol, args.npdep)
-    x, _, _ = gssvx(opts, a, b, stats=stats, grid=g)
+    x, lu, _ = gssvx(opts, a, b, stats=stats, grid=g)
+    if getattr(args, "stats", False):
+        from ..parallel.factor_dist import measure_comm
+        stats.comm_measured = measure_comm(lu.device_lu,
+                                           nrhs=b.shape[1])
     return x
 
 

@@ -128,6 +128,8 @@ def factorize(a: CSRMatrix, options: Options | None = None,
                     plan, mesh, dtype=np.dtype(options.factor_dtype))
             dist_lu = cache[key](scaled)
             stats.tiny_pivots += dist_lu.tiny_pivots
+            stats.comm_predicted = dist_lu.schedule.comm_summary(
+                np.dtype(options.factor_dtype))
             lu = LUFactorization(plan=plan, backend="dist",
                                  device_lu=dist_lu, a=a, stats=stats)
         else:

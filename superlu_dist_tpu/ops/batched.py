@@ -95,8 +95,17 @@ class GroupSpec:
     a_src: np.ndarray          # (ndev, La) into vals (+ zero slot)
     a_dst: np.ndarray          # (ndev, La) local-front linear indices
     one_dst: np.ndarray        # (ndev, Lo)
-    ea_src: np.ndarray         # (ndev, Le) into replicated upd_buf
-    ea_dst: np.ndarray         # (ndev, Le)
+    # Extend-add in OUTER-PRODUCT form: child updates are rc×rc blocks
+    # whose scatter indices factor as (pos_i, pos_j) outer sums, so the
+    # host ships only O(rc) positions per child and the rc² flat
+    # indices are computed on device at gather/scatter time.  (The
+    # materialized-index formulation hit 2.6e9 int64 entries at the
+    # k=64 3D Laplacian — 21 GB host, 10 GB device — and dominated
+    # schedule build time.)  Children are bucketed by padded rc; each
+    # block is (src_off, stride, dst_base, pos) stacked (ndev, K[, rc_b])
+    # with meta (rc_b, K, C): K padded child count, C fori_loop chunk.
+    ea_hosts: tuple            # per-bucket (src_off, stride, dst_base, pos)
+    ea_meta: tuple             # per-bucket (rc_b, K, C) static ints
     col_idx: np.ndarray        # (ndev, n_loc, wb) global cols, pad -> n
     struct_idx: np.ndarray     # (ndev, n_loc, mb-wb) pad -> n
     upd_off_global: int        # start of this group's global slab
@@ -127,7 +136,8 @@ class GroupSpec:
     def dev(self, squeeze: bool):
         """Device copies of the index arrays (cached per `squeeze`).
         squeeze=True drops the leading ndev=1 axis for the
-        single-device path."""
+        single-device path.  Position 3 is the ea-block pytree (tuple
+        of per-bucket 4-tuples)."""
         if self._dev is None:
             self._dev = {}
         if squeeze not in self._dev:
@@ -135,19 +145,26 @@ class GroupSpec:
             fdt = jnp.int32 if f_loc < 2**31 - 1 else jnp.int64
             sdt = (jnp.int32 if int(self.a_src.max(initial=0)) < 2**31 - 1
                    else jnp.int64)
-            edt = (jnp.int32 if int(self.ea_src.max(initial=0)) < 2**31 - 1
-                   else jnp.int64)
+            eblocks = []
+            for (rc_b, K, C), (so, st, db, ps) in zip(self.ea_meta,
+                                                      self.ea_hosts):
+                span = (int(so.max(initial=0))
+                        + int(st.max(initial=0)) * rc_b + rc_b)
+                edt = jnp.int32 if span < 2**31 - 1 else jnp.int64
+                eblocks.append((jnp.asarray(so, dtype=edt),
+                                jnp.asarray(st, dtype=edt),
+                                jnp.asarray(db, dtype=fdt),
+                                jnp.asarray(ps, dtype=jnp.int32)))
             arrs = (
                 jnp.asarray(self.a_src, dtype=sdt),
                 jnp.asarray(self.a_dst, dtype=fdt),
                 jnp.asarray(self.one_dst, dtype=fdt),
-                jnp.asarray(self.ea_src, dtype=edt),
-                jnp.asarray(self.ea_dst, dtype=fdt),
+                tuple(eblocks),
                 jnp.asarray(self.col_idx, dtype=jnp.int32),
                 jnp.asarray(self.struct_idx, dtype=jnp.int32),
             )
             if squeeze:
-                arrs = tuple(a[0] for a in arrs)
+                arrs = jax.tree_util.tree_map(lambda a: a[0], arrs)
             self._dev[squeeze] = arrs
         return self._dev[squeeze]
 
@@ -397,8 +414,10 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             sup_pos = np.empty(len(slist), dtype=np.int64)
             pos_of = {s: i for i, s in enumerate(slist)}
             per_dev = {k: [[] for _ in range(ndev)]
-                       for k in ("a_src", "a_dst", "one", "ea_src",
-                                 "ea_dst")}
+                       for k in ("a_src", "a_dst", "one")}
+            # extend-add child records, outer-product form: per child
+            # only (rc, slab offset, slab stride, front base, positions)
+            child_recs = [[] for _ in range(ndev)]
             col_idx = np.full((ndev, n_loc, wb), n, dtype=np.int64)
             struct_idx = np.full((ndev, n_loc, rb), n, dtype=np.int64)
 
@@ -421,13 +440,9 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         rbc = int(fp.mb[c]) - int(fp.wb[c])
                         coff = sup_upd_off[c]
                         assert coff >= 0, "child scheduled after parent"
-                        ar = np.arange(rc)
-                        per_dev["ea_src"][d].append(
-                            (coff + ar[:, None] * rbc + ar[None, :]).ravel())
-                        pos = _pad_pos(fp.ea_map[c], w, wb)
-                        per_dev["ea_dst"][d].append(
-                            (base + pos[:, None] * mb
-                             + pos[None, :]).ravel())
+                        child_recs[d].append(
+                            (rc, int(coff), rbc, base,
+                             _pad_pos(fp.ea_map[c], w, wb)))
                     if coop and d > 0:
                         # replicated fronts: factor work is shared, but
                         # ownership (slab slot, solve updates, diag-U
@@ -449,6 +464,39 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 for b in range(len(per_dev_s[d]), n_loc):
                     t = np.arange(wb)
                     per_dev["one"][d].append(b * mb * mb + t * mb + t)
+
+            # bucket the child records by padded rc; K aligned across
+            # devices and rounded to the chunk size when chunked.  The
+            # chunk cap bounds the per-chunk transient gather/scatter
+            # tensors (C·rc_b² elements ≈ 16 MB int32).
+            by_rc: dict = {}
+            for d in range(ndev):
+                for rec in child_recs[d]:
+                    by_rc.setdefault(_next_bucket(rec[0]),
+                                     [[] for _ in range(ndev)])[d].append(rec)
+            ea_hosts, ea_meta = [], []
+            for rc_b in sorted(by_rc):
+                per_d = by_rc[rc_b]
+                K = _next_bucket(max(len(v) for v in per_d))
+                C = max(1, (1 << 22) // (rc_b * rc_b))
+                if K > C:
+                    K = -(-K // C) * C
+                else:
+                    C = K
+                so = np.zeros((ndev, K), dtype=np.int64)
+                st = np.zeros((ndev, K), dtype=np.int64)
+                db = np.zeros((ndev, K), dtype=np.int64)
+                # pos == mb is the padding sentinel (dropped on device)
+                ps = np.full((ndev, K, rc_b), mb, dtype=np.int64)
+                for d in range(ndev):
+                    for i, (rc, coff, rbc, base, pos) in \
+                            enumerate(per_d[d]):
+                        so[d, i] = coff
+                        st[d, i] = rbc
+                        db[d, i] = base
+                        ps[d, i, :rc] = pos
+                ea_hosts.append((so, st, db, ps))
+                ea_meta.append((rc_b, K, C))
 
             def stack(key, fill, distinct_pad=False):
                 """distinct_pad gives every padding slot its own
@@ -478,8 +526,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 a_src=stack("a_src", nnz),
                 a_dst=stack("a_dst", f_loc, distinct_pad=True),
                 one_dst=stack("one", f_loc, distinct_pad=True),
-                ea_src=stack("ea_src", -1),      # finalized below
-                ea_dst=stack("ea_dst", f_loc),
+                ea_hosts=tuple(ea_hosts), ea_meta=tuple(ea_meta),
                 col_idx=col_idx, struct_idx=struct_idx,
                 upd_off_global=upd_off,
                 L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur,
@@ -497,17 +544,16 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             Li_cur += n_loc * wb * wb
             Ui_cur += n_loc * wb * wb
 
-    # ea_src pads -> index of the zero slot appended at upd_total.
-    # Sort the add-scatter (dst, src) pairs by destination (free on the
-    # host, adds commute): the device scatters can then carry the
+    # Sort the A-assembly (dst, src) pairs by destination (free on the
+    # host, adds commute): the device scatter can then carry the
     # indices_are_sorted promise, the parallel-friendly lowering.
+    # (Extend-add indices are device-computed per block now — no host
+    # pairs to sort; their scatter runs without ordering promises.)
     for g in groups:
-        g.ea_src[g.ea_src == -1] = upd_peak
-        for dst, src in ((g.ea_dst, g.ea_src), (g.a_dst, g.a_src)):
-            for d in range(dst.shape[0]):
-                o = np.argsort(dst[d], kind="stable")
-                dst[d] = dst[d][o]
-                src[d] = src[d][o]
+        for d in range(g.a_dst.shape[0]):
+            o = np.argsort(g.a_dst[d], kind="stable")
+            g.a_dst[d] = g.a_dst[d][o]
+            g.a_src[d] = g.a_src[d][o]
 
     # gather post-pass, from ACTUAL placements (parents are always
     # scheduled after their children, so sup_dev is complete here): a
@@ -629,10 +675,57 @@ def psum_exact(x, axis):
     return jax.lax.psum(x, axis)
 
 
+def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int):
+    """Extend-add of child update blocks into the flat front batch F.
+    Outer-product form: per child only its O(rc) position vector ships
+    from the host; the rc² gather/scatter indices are iota arithmetic
+    on device.  Children are bucketed by padded rc; buckets with many
+    children run as a fori_loop over C-child chunks so the transient
+    index/update tensors stay bounded (~tens of MB) instead of
+    materializing a whole leaf level at once."""
+    f_loc = n_pad * mb * mb
+
+    for (rc_b, K, C), (so, st, db, ps) in zip(ea_meta, ea_blocks):
+        so = so.reshape(-1)
+        st = st.reshape(-1)
+        db = db.reshape(-1)
+        ps = ps.reshape(-1, ps.shape[-1])
+
+        def add_chunk(Ff, so, st, db, ps):
+            ai = jnp.arange(rc_b, dtype=so.dtype)
+            src = (so[:, None, None]
+                   + ai[None, :, None] * st[:, None, None]
+                   + ai[None, None, :]).reshape(-1)
+            upd = upd_buf[src]
+            pi = ps[:, :, None].astype(db.dtype)
+            pj = ps[:, None, :].astype(db.dtype)
+            dst = db[:, None, None] + pi * mb + pj
+            # pos == mb is the padding sentinel (real positions < mb);
+            # route those lanes out of bounds so mode="drop" kills them
+            dst = jnp.where((pi >= mb) | (pj >= mb),
+                            jnp.asarray(f_loc, db.dtype), dst)
+            return Ff.at[dst.reshape(-1)].add(upd, mode="drop")
+
+        if K <= C:
+            F = add_chunk(F, so, st, db, ps)
+        else:
+            def body(i, Ff):
+                s0 = i * C
+                return add_chunk(
+                    Ff,
+                    jax.lax.dynamic_slice_in_dim(so, s0, C, 0),
+                    jax.lax.dynamic_slice_in_dim(st, s0, C, 0),
+                    jax.lax.dynamic_slice_in_dim(db, s0, C, 0),
+                    jax.lax.dynamic_slice_in_dim(ps, s0, C, 0))
+            F = jax.lax.fori_loop(0, K // C, body, F)
+    return F
+
+
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        tiny, nzero, thresh, a_src, a_dst, one_dst,
-                       ea_src, ea_dst, upd_off, L_off, U_off, Li_off,
+                       ea_blocks, upd_off, L_off, U_off, Li_off,
                        Ui_off, *, mb: int, wb: int, n_pad: int,
+                       ea_meta: tuple = (),
                        axis: Optional[str] = None,
                        gather: bool = True, coop: bool = False,
                        ndev: int = 1):
@@ -646,8 +739,7 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[a_dst].add(vals[a_src], mode="drop",
                         unique_indices=True, indices_are_sorted=True)
     F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
-    F = F.at[ea_dst].add(upd_buf[ea_src], mode="drop",
-                         indices_are_sorted=True)
+    F = _ea_add(F, upd_buf, ea_blocks, ea_meta, mb=mb, n_pad=n_pad)
     F = F.reshape(n_pad, mb, mb)
 
     if coop and axis is not None:
@@ -865,7 +957,7 @@ def _phase_fns(sched, dtype, thresh_np):
         return cache[key]
     from ..parallel.factor_dist import _factor_loop, _solve_loop
     per_group = [g.dev(squeeze=True) for g in sched.groups]
-    pairs = [(t[5], t[6]) for t in per_group]
+    pairs = [(t[4], t[5]) for t in per_group]
     dtype = np.dtype(dtype)
 
     @jax.jit
@@ -960,16 +1052,17 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         tiny = jnp.zeros((), jnp.int32)
         nzero = jnp.zeros((), jnp.int32)
         for g in sched.groups:
-            a_src, a_dst, one_dst, ea_src, ea_dst, _, _ = \
+            a_src, a_dst, one_dst, ea_blocks, _, _ = \
                 g.dev(squeeze=True)
             (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
              nzero) = _factor_group_impl(
                     vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
-                    tiny, nzero, thresh, a_src, a_dst, one_dst, ea_src,
-                    ea_dst, jnp.int32(g.upd_off_global),
+                    tiny, nzero, thresh, a_src, a_dst, one_dst,
+                    ea_blocks, jnp.int32(g.upd_off_global),
                     jnp.int32(g.L_off), jnp.int32(g.U_off),
                     jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
-                    mb=g.mb, wb=g.wb, n_pad=g.n_loc)
+                    mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                    ea_meta=g.ea_meta)
         # promote rather than cast: a complex rhs against a real
         # factor must stay complex (matches solve_device)
         xdt = jnp.promote_types(dtype, b.dtype)
@@ -978,14 +1071,14 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         X = X.at[:sched.n, :].set(b.astype(xdt))
         X = _enc(X, cplx)
         for g in sched.groups:
-            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
             X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
                                 struct_idx, jnp.int32(g.L_off),
                                 jnp.int32(g.Li_off),
                                 mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                                 cplx=cplx)
         for g in reversed(sched.groups):
-            _, _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
             X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
                                 struct_idx, jnp.int32(g.U_off),
                                 jnp.int32(g.Ui_off),
@@ -1087,7 +1180,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         sweeps run in factor precision like the reference's psgsrfs."""
         from ..parallel.factor_dist import _solve_loop
         bf = (r * ops["row_scale"][:, None])[ops["inv_final_row"]]
-        solve_idx = [(t[5], t[6]) for t in per_group]
+        solve_idx = [(t[4], t[5]) for t in per_group]
         y = _solve_loop(sched, tuple(flats), bf.astype(dtype), dtype,
                         solve_idx, axis, trans=False)
         return (y[ops["final_col"]].astype(rdt)
@@ -1174,7 +1267,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
 
     def mapped_body(vals, b, *idx_flat):
         from ..parallel.factor_dist import _regroup
-        return step_body(vals, b, _regroup(sched, idx_flat, 7))
+        return step_body(vals, b, _regroup(sched, idx_flat, 6))
 
     mapped = jax.shard_map(
         mapped_body, mesh=mesh,

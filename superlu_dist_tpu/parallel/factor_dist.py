@@ -67,9 +67,11 @@ def _resolve_axis(mesh: Mesh, axis):
 
 def _regroup(dsched, idx_flat, per):
     """Flat shard_map operand list -> per-group tuples, leading
-    device-block dim stripped."""
+    device-block dim stripped.  Items may be pytrees (the ea-block
+    tuples), hence the tree_map."""
     it = iter(idx_flat)
-    return [tuple(next(it)[0] for _ in range(per))
+    return [tuple(jax.tree_util.tree_map(lambda a: a[0], next(it))
+                  for _ in range(per))
             for _ in dsched.groups]
 
 
@@ -86,14 +88,15 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
     tiny = jnp.zeros((), jnp.int32)
     nzero = jnp.zeros((), jnp.int32)
     for g, idx in zip(dsched.groups, per_group):
-        a_src, a_dst, one_dst, ea_src, ea_dst = idx[:5]
+        a_src, a_dst, one_dst, ea_blocks = idx[:4]
         (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
          nzero) = _factor_group_impl(
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
-            nzero, thresh, a_src, a_dst, one_dst, ea_src, ea_dst,
+            nzero, thresh, a_src, a_dst, one_dst, ea_blocks,
             jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
             jnp.int32(g.U_off), jnp.int32(g.Li_off),
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+            ea_meta=g.ea_meta,
             axis=axis, gather=g.needs_gather, coop=g.coop,
             ndev=dsched.ndev)
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
@@ -174,14 +177,14 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(7))
+    idx_args = _group_operands(dsched, range(6))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, b, *idx_flat):
-        per_group = _regroup(dsched, idx_flat, 7)
+        per_group = _regroup(dsched, idx_flat, 6)
         flats = _factor_loop(dsched, vals, thresh_np, dtype,
                              per_group, axis)[:4]
-        solve_idx = [(t[5], t[6]) for t in per_group]
+        solve_idx = [(t[4], t[5]) for t in per_group]
         return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
                            trans=False)
 
@@ -228,11 +231,11 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(5))
+    idx_args = _group_operands(dsched, range(4))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, *idx_flat):
-        per_group = _regroup(dsched, idx_flat, 5)
+        per_group = _regroup(dsched, idx_flat, 4)
         L, U, Li, Ui, tiny, nzero = _factor_loop(
             dsched, vals, thresh_np, dtype, per_group, axis)
         return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
@@ -253,6 +256,7 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                       schedule=dsched, L_flat=L, U_flat=U, Li_flat=Li,
                       Ui_flat=Ui, tiny_pivots=int(tiny))
 
+    factor.jitted = jitted  # exposed for HLO inspection (measure_comm)
     return factor
 
 
@@ -264,7 +268,7 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dsched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
 
-    idx_args = _group_operands(dsched, (5, 6))
+    idx_args = _group_operands(dsched, (4, 5))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_flat):
@@ -282,6 +286,44 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
 
     return solve
+
+
+def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
+    """Measured collective inventory of the compiled distributed
+    factor and solve programs (per-phase counts + bytes from the
+    post-optimization HLO) — the runtime-measured side of the
+    SCT_print3D contract; compare against
+    `dlu.schedule.comm_summary(dlu.dtype, nrhs)`.  Reuses the plan's
+    cached factor/solve closures (the ones gssvx/dist_solve built), so
+    programs that already executed are lowering+cache-hit, not
+    recompiled."""
+    from ..utils.stats import hlo_collective_stats
+    plan = dlu.plan
+    fcache = getattr(plan, "_dist_factor_fns", None)
+    if fcache is None:
+        fcache = plan._dist_factor_fns = {}
+    fkey = (dlu.mesh, dlu.dtype.str)
+    if fkey not in fcache:
+        fcache[fkey] = make_dist_factor(plan, dlu.mesh,
+                                        dtype=dlu.dtype, axis=dlu.axis)
+    factor = fcache[fkey]
+    scache = getattr(plan, "_dist_solve_fns", None)
+    if scache is None:
+        scache = plan._dist_solve_fns = {}
+    skey = (dlu.mesh, dlu.dtype.str, dlu.axis, False)
+    if skey not in scache:
+        scache[skey] = make_dist_solve(plan, dlu.mesh, dtype=dlu.dtype,
+                                       axis=dlu.axis, trans=False)
+    solve = scache[skey]
+    vals = jnp.zeros(len(plan.coo_rows), dlu.dtype)
+    out = {}
+    txt = factor.jitted.lower(vals).compile().as_text()
+    out["FACT"] = hlo_collective_stats(txt)
+    b = jnp.zeros((dlu.schedule.n, nrhs), dlu.dtype)
+    txt = solve.lower(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                      dlu.Ui_flat, b).compile().as_text()
+    out["SOLVE"] = hlo_collective_stats(txt)
+    return out
 
 
 def dist_solve(dlu: DistLU, b_factor_order, trans: bool = False):

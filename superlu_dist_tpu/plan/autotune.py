@@ -94,10 +94,22 @@ def autotuned_options(plan, options=None, max_width_buckets: int = 10,
     w = np.asarray([int(x) for x in fp.w])
     m = np.asarray([int(x) for x in fp.m])
 
-    # weight each supernode by its flop share so the DP optimizes where
-    # the work is
+    # Weight each supernode by its flop share PLUS its scale-normalized
+    # storage share.  Flops alone fail at mesh scale: the handful of
+    # giant separator fronts carries ~all flops, so the per-bucket
+    # penalty (λ ∝ total weight) grows past what the thousands of tiny
+    # leaf fronts can justify, the DP folds them into the separators'
+    # bucket, and LU/update-slab memory inflates ~25x (observed on the
+    # k=64 3D Laplacian: 22k of 22.3k fronts in one (192,1096) bucket,
+    # 62 GB padded LU for 1.7 GB true).  Entries are leaf-dominated, so
+    # κ·entries (κ equalizing the two totals) restores the leaves'
+    # bargaining power and keeps padding a bounded multiple of true
+    # storage while still optimizing flops where the flops are.
     flops = w * w * m + w * (m - w) ** 2 + 1.0
-    wb = _dp_buckets(w, flops, max_width_buckets, power=1.0)
+    entries = w * (w + 2.0 * (m - w)) + 1.0
+    kappa = float(np.sum(flops)) / float(np.sum(entries))
+    weight = flops + kappa * entries
+    wb = _dp_buckets(w, weight, max_width_buckets, power=1.0)
 
     # legalize widths first: the blocked LU kernel needs wb ≤ 32 or
     # wb ≡ 0 mod 32 (dense_lu.partial_lu block size), and TPU tiles
@@ -114,7 +126,7 @@ def autotuned_options(plan, options=None, max_width_buckets: int = 10,
     wb_arr = np.asarray(wb)
     wb_of = wb_arr[np.searchsorted(wb_arr, w)]
     m_eff = np.maximum(wb_of + (m - w), m)
-    mb = _dp_buckets(m_eff, flops, max_front_buckets, power=2.0)
+    mb = _dp_buckets(m_eff, weight, max_front_buckets, power=2.0)
     mb = sorted({-(-int(v) // 8) * 8 for v in mb})
     return options.replace(width_buckets=tuple(wb),
                            front_buckets=tuple(mb))

@@ -21,6 +21,55 @@ PHASES = (
 )
 
 
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_HLO_COLLECTIVES = ("all-gather", "all-reduce", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+
+def hlo_collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Measured collective inventory of a compiled XLA module: count
+    and result bytes per collective kind, parsed from the
+    post-optimization HLO (`compiled.as_text()`).  This is the
+    ground-truth cross-check for the schedule's *predicted* traffic
+    (BatchedSchedule.comm_summary — the SCT_t measured-counters
+    contract, SRC/util_dist.h:194-317, realized as
+    compiled-artifact inspection instead of runtime probes: under XLA
+    the program IS the message schedule)."""
+    import re
+    out: Dict[str, Dict[str, int]] = {}
+    # Sync form:   %ag  = f32[8,128]{1,0} all-gather(...)
+    # Async pair:  %ags = (f32[1,128], f32[8,128]) all-gather-start(...)
+    #              %agd = f32[8,128]{1,0} all-gather-done(...)
+    # The -start tuple mixes operand and result shapes (it would
+    # double-count local+global), so async collectives are counted at
+    # their -done op, whose result IS the collective's output; -start
+    # is skipped.  CPU emits the sync form, TPU the async pair — both
+    # land on the same numbers this way.
+    shape_re = re.compile(r"(\w+)\[([\d,]*)\]")
+    op_re = re.compile(
+        r"= ([^=]*?) (" + "|".join(_HLO_COLLECTIVES) + r")(-done)?\(")
+    for m in op_re.finditer(hlo_text):
+        shapes, kind = m.group(1), m.group(2)
+        nbytes = 0
+        for dt, dims in shape_re.findall(shapes):
+            if dt not in _HLO_DTYPE_BYTES:
+                continue
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            nbytes += _HLO_DTYPE_BYTES[dt] * elems
+        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+    return out
+
+
 @dataclasses.dataclass
 class Stats:
     utime: Dict[str, float] = dataclasses.field(
@@ -34,6 +83,13 @@ class Stats:
     lu_nnz: int = 0
     lu_bytes: int = 0
     workspace_bytes: int = 0
+    # collective traffic: predicted from the schedule (comm_summary)
+    # and measured from the compiled HLO (hlo_collective_stats) — the
+    # SCT_print3D comm-volume contract
+    comm_predicted: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    comm_measured: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
 
     @contextlib.contextmanager
     def timer(self, phase: str):
@@ -67,4 +123,15 @@ class Stats:
         if self.lu_nnz:
             lines.append(
                 f"  nnz(L+U): {self.lu_nnz}  LU bytes: {self.lu_bytes}")
+        if self.comm_predicted:
+            lines.append("** Collective traffic (predicted) **")
+            for k, v in self.comm_predicted.items():
+                lines.append(f"  {k:<24s} {v}")
+        if self.comm_measured:
+            lines.append("** Collective traffic (measured, compiled HLO) **")
+            for phase, kinds in self.comm_measured.items():
+                for k, v in kinds.items():
+                    lines.append(f"  {phase}/{k:<18s} "
+                                 f"count {v['count']:<5d} "
+                                 f"bytes {v['bytes']}")
         return "\n".join(lines)

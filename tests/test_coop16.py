@@ -1,0 +1,125 @@
+"""16-device scaling evidence for the cooperative tree-top LU (VERDICT
+round-1 item 6): the conftest pins 8 virtual devices, so these tests
+run a fresh subprocess with a 16-device CPU platform and check
+
+  * mesh-shape invariance at (4,4) and (4,2,2), and
+  * the coop-psum share of total step traffic stays a minority share
+    (the 1-D column-sharded scheme does not become psum-bound at 16
+    devices; reference frame: the 2D block-cyclic panel map,
+    SRC/superlu_defs.h:357-382).
+
+Subprocess strategy mirrors the reference's oversubscribed-MPI-ranks
+CTest sweep (TEST/CMakeLists.txt:48-53) at a rank count the main
+process cannot host."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_SCRIPT = r"""
+import json
+import numpy as np
+import scipy.sparse as sp
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 16)
+
+from superlu_dist_tpu.utils.cache import host_cache_dir
+import os
+jax.config.update("jax_compilation_cache_dir", host_cache_dir(
+    os.path.join(os.environ["PYTHONPATH"], ".jax_cache")))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from superlu_dist_tpu import Options, csr_from_scipy
+from superlu_dist_tpu.ops.batched import get_schedule
+from superlu_dist_tpu.parallel.factor_dist import (make_dist_step,
+                                                   measure_comm,
+                                                   make_dist_factor)
+from superlu_dist_tpu.parallel.grid import make_solver_mesh
+from superlu_dist_tpu.plan.plan import plan_factorization
+
+t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(48, 48))
+a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+rng = np.random.default_rng(0)
+xtrue = rng.standard_normal((a.n, 2))
+b = a.to_scipy() @ xtrue
+
+plan = plan_factorization(a, Options())
+# factor-space RHS/solution transforms (what the gssvx driver does)
+vals = plan.scaled_values(a)
+bf = np.empty_like(b)
+bf[plan.final_row] = b * plan.row_scale[:, None]
+out = {}
+for shape in ((4, 4), (4, 2, 2)):
+    g = make_solver_mesh(*shape)
+    step, sched = make_dist_step(plan, g.mesh)
+    x = np.asarray(step(vals, bf))
+    xs = x[plan.final_col] * plan.col_scale[:, None]
+    out[str(shape)] = float(np.linalg.norm(xs - xtrue)
+                            / np.linalg.norm(xtrue))
+    coop = [gr for gr in sched.groups if gr.coop]
+    cs = sched.comm_summary(np.float64, nrhs=2)
+    out.setdefault("coop_groups", {})[str(shape)] = len(coop)
+    out.setdefault("comm", {})[str(shape)] = cs
+# measured traffic on the 16-device flat partition
+factor = make_dist_factor(plan, make_solver_mesh(4, 4).mesh)
+dlu = factor(vals)
+out["measured"] = measure_comm(dlu, nrhs=2)
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_16dev_invariance_and_coop_share():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SLU_COOP_MB"] = "32"  # engage coop on the small test fronts
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    p = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                       capture_output=True, text=True, timeout=1500)
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = [ln for ln in p.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    out = json.loads(line[len("RESULT "):])
+    # mesh-shape invariance: both 16-device shapes solve to f64 class
+    assert out["(4, 4)"] < 1e-10
+    assert out["(4, 2, 2)"] < 1e-10
+    # the same flat front partition underlies both shapes
+    assert out["comm"]["(4, 4)"] == out["comm"]["(4, 2, 2)"]
+    # coop actually engaged at 16 devices (tree-top groups)
+    assert out["coop_groups"]["(4, 4)"] >= 1
+    # measured factor all-gather bytes equal the prediction at 16 dev
+    cs = out["comm"]["(4, 4)"]
+    ag = out["measured"]["FACT"].get("all-gather",
+                                     {"count": 0, "bytes": 0})
+    assert ag["bytes"] == cs["factor_allgather_bytes"], (ag, cs)
+
+
+def test_coop_share_minority_at_16dev_bench_matrix():
+    """On the bench-class matrix (3D Laplacian n=27k) with the
+    PRODUCTION coop threshold, the 1-D column-sharded coop scheme's
+    psum bytes stay <20% of total step traffic at 16 devices — the
+    quantitative case that 1-D suffices at this scale (vs the
+    reference's 2-D block-cyclic panel map).  Pure schedule
+    accounting, no device execution."""
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import build_schedule
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    assert os.environ.get("SLU_COOP_MB") is None  # production default
+    a = laplacian_3d(30)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    sched = build_schedule(plan, 16)
+    assert any(g.coop for g in sched.groups), \
+        "tree-top coop must engage on the bench matrix at 16 devices"
+    cs = sched.comm_summary(np.float32)
+    total = (cs["factor_allgather_bytes"] + cs["coop_psum_bytes"]
+             + cs["solve_sync_bytes"])
+    share = cs["coop_psum_bytes"] / total
+    assert share < 0.20, f"coop psum share {share:.2%} of {total}"
