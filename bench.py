@@ -245,12 +245,13 @@ def main():
                 f.write(json.dumps(rec) + "\n")
 
         emit(r)
-        extras = [(laplacian_3d(64), "3D Laplacian n=262144", 1)]
+        extras = [(lambda: laplacian_3d(64), "3D Laplacian n=262144", 1)]
         if nrhs != 64:  # skip if the primary already covered nrhs=64
-            extras.insert(0, (a, desc, 64))          # many-RHS regime
-        for a2, d2, nr2 in extras:
-            try:
-                emit(_run_config(a2, d2, nr2, jnp))
+            extras.insert(0, (lambda: a, desc, 64))  # many-RHS regime
+        for mk2, d2, nr2 in extras:
+            try:  # matrix construction inside: an OOM building the
+                  # extra is a sweep record, not a process failure
+                emit(_run_config(mk2(), d2, nr2, jnp))
             except Exception as e:
                 emit(dict(desc=d2, error=repr(e)))
 

@@ -187,10 +187,13 @@ class BatchedSchedule:
         instead of measured): words moved by factor all_gathers, coop
         panel/trailing psums, and solve sync psums.
 
-        Counting conventions: each coop psum counts as ONE collective
-        here, but complex factor dtypes execute it as TWO real
-        all-reduces (psum_exact splits real/imag) — the *byte* totals
-        coincide, the collective count understates by 2x for c64/c128.
+        Counting conventions: each coop panel psum counts as ONE
+        collective here, but complex factor dtypes execute it as TWO
+        real all-reduces (psum_exact splits real/imag) — the *byte*
+        totals coincide, the collective count understates by 2x for
+        c64/c128.  The coop trailing recombination is an all_gather of
+        disjoint column slices (coop_gather_bytes), separate from the
+        update-slab all_gathers (factor_allgather_bytes).
         solve_sync_bytes is sized by the caller-passed dtype; the sweep
         actually moves the real-view-encoded X, which is again
         byte-identical for complex."""
@@ -198,21 +201,25 @@ class BatchedSchedule:
         gather_b = sum(g.n_loc * self.ndev * (g.mb - g.wb) ** 2 * it
                        for g in self.groups
                        if g.needs_gather and g.mb > g.wb)
-        coop_b = 0
+        coop_psum_b = coop_gather_b = 0
         for g in self.groups:
             if g.coop:
                 # panel psums total mb·wb words regardless of the
-                # panel block size; trailing psum covers the padded
-                # column remainder
+                # panel block size; the trailing all_gather moves each
+                # device's padded (mb, cb) column slice
                 cb = -(-g.mb // self.ndev)
-                coop_b += g.n_loc * it * (
-                    g.wb * g.mb
-                    + g.mb * (cb * self.ndev - g.wb))
+                coop_psum_b += g.n_loc * it * g.wb * g.mb
+                # the kernel gathers whenever wb < mbp (= cb·ndev):
+                # column PADDING alone triggers it even at mb == wb
+                if g.wb < cb * self.ndev:
+                    coop_gather_b += (g.n_loc * it
+                                      * g.mb * cb * self.ndev)
         syncs = (sum(1 for g in self.groups if g.fwd_sync)
                  + sum(1 for g in self.groups if g.bwd_sync) + 2)
         return {
             "factor_allgather_bytes": int(gather_b),
-            "coop_psum_bytes": int(coop_b),
+            "coop_psum_bytes": int(coop_psum_b),
+            "coop_gather_bytes": int(coop_gather_b),
             "solve_syncs": int(syncs) if self.ndev > 1 else 0,
             "solve_sync_bytes": (int(syncs * (self.n + 1) * nrhs * it)
                                  if self.ndev > 1 else 0),

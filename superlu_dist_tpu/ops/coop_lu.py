@@ -19,10 +19,13 @@ already device-local), then a right-looking blocked LU runs with
     slice each panel step.
 
 Communication per front: one (mb, pb) psum per panel step (collecting
-the next panel's columns from their owner) plus one final psum of the
-trailing block to recombine the Schur complement — ~2·mb² words over ICI,
-the same order as a single front broadcast, versus the reference's
-per-panel broadcasts.
+the next panel's columns from their owner) plus one final all_gather
+of the disjoint trailing column slices to recombine the Schur
+complement — ~mb² words over ICI, the same order as a single front
+broadcast, versus the reference's per-panel broadcasts.  The
+recombination broadcast is the price of the replicated-parent design;
+the measured 16-device share (tests/test_coop16.py) motivates the
+sharded coop-chain follow-up (DESIGN.md §5).
 
 The result F is bitwise identical on every device, so the caller's
 panel extraction, inverse preparation and slab writes run unchanged
@@ -101,7 +104,6 @@ def _coop_lu_one(F, thresh, *, wb: int, mb: int, mbp: int, cb: int,
     kept current through the trailing updates; panel columns are
     recombined by psum as they are reached."""
     dev = jax.lax.axis_index(axis)
-    colg = jax.lax.broadcasted_iota(jnp.int32, (1, mbp), 1)
     rows = jax.lax.broadcasted_iota(jnp.int32, (mb, 1), 0)
     cols_pb = jax.lax.broadcasted_iota(jnp.int32, (1, pb), 1)
     cols_cb = jax.lax.broadcasted_iota(jnp.int32, (1, cb), 1)
@@ -144,13 +146,17 @@ def _coop_lu_one(F, thresh, *, wb: int, mb: int, mbp: int, cb: int,
     zero = jnp.zeros((), jnp.int32)
     F, tiny, nzero = jax.lax.fori_loop(0, wb // pb, panel_step,
                                        (F, zero, zero))
-    # recombine: panel columns (< wb) are final everywhere; trailing
-    # columns are current on their owner only — psum just the trailing
-    # block, the panel columns would be all-reduced zeros
+    # Recombine: panel columns (< wb) are final everywhere; trailing
+    # columns are current on their owner only.  The owners' slices are
+    # DISJOINT, so this is an all_gather of contiguous (mb, cb) column
+    # slices, not a reduction — half the wire cost of the earlier
+    # zero-masked psum (all-reduce moves every byte twice) and no
+    # floating-point adds at all.  Values are bitwise identical.
     if wb < mbp:
-        mine_t = colg[:, wb:] // cb == dev
-        trail = _psum(jnp.where(mine_t, F[:, wb:], 0), axis)
-        F = jnp.concatenate([F[:, :wb], trail], axis=1)
+        mysl = jax.lax.dynamic_slice(F, (zero_i, my0), (mb, cb))
+        allsl = jax.lax.all_gather(mysl, axis)        # (ndev, mb, cb)
+        full = jnp.moveaxis(allsl, 0, 1).reshape(mb, mbp)
+        F = jnp.concatenate([F[:, :wb], full[:, wb:]], axis=1)
     return F, tiny, nzero
 
 

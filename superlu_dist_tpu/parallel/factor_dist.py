@@ -315,7 +315,12 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
         scache[skey] = make_dist_solve(plan, dlu.mesh, dtype=dlu.dtype,
                                        axis=dlu.axis, trans=False)
     solve = scache[skey]
-    vals = jnp.zeros(len(plan.coo_rows), dlu.dtype)
+    # lower with the dtype production traced with: factor consumes
+    # plan.scaled_values(a) — f64 for real systems, c128 for complex —
+    # NOT the factor dtype (the cast happens inside the program); a
+    # mismatched aval here would force a pointless full recompile
+    vdt = np.complex128 if dlu.dtype.kind == "c" else np.float64
+    vals = jnp.zeros(len(plan.coo_rows), vdt)
     out = {}
     txt = factor.jitted.lower(vals).compile().as_text()
     out["FACT"] = hlo_collective_stats(txt)

@@ -236,7 +236,7 @@ def test_comm_summary_accounting():
 def test_comm_summary_coop_bytes(monkeypatch):
     """Coop traffic accounting matches the collectives coop_lu
     actually issues: wb/pb panel psums of (mb, pb) + one trailing
-    (mb, mbp - wb) psum per front."""
+    all_gather of the (mb, cb) column slices per front."""
     import scipy.sparse as sp
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.ops.batched import get_schedule
@@ -251,10 +251,13 @@ def test_comm_summary_coop_bytes(monkeypatch):
     s = get_schedule(plan, 8)
     coop = [g for g in s.groups if g.coop]
     assert coop
-    expect = 0
+    exp_psum = exp_gather = 0
     for g in coop:
         pb = _pick_pb(g.wb)
         cb = -(-g.mb // 8)
-        per_front = (g.wb // pb) * g.mb * pb + g.mb * (cb * 8 - g.wb)
-        expect += g.n_loc * per_front * 4
-    assert s.comm_summary(np.float32)["coop_psum_bytes"] == expect
+        exp_psum += g.n_loc * (g.wb // pb) * g.mb * pb * 4
+        if g.mb > g.wb:
+            exp_gather += g.n_loc * g.mb * cb * 8 * 4
+    cs = s.comm_summary(np.float32)
+    assert cs["coop_psum_bytes"] == exp_psum
+    assert cs["coop_gather_bytes"] == exp_gather
