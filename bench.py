@@ -157,6 +157,17 @@ def _run_config(a, desc, nrhs, jnp):
 def main():
     cpu_fallback, fb_reason = _ensure_live_backend()
 
+    # CPU execution: cap codegen at AVX2 so compiled artifacts stay
+    # valid if the VM live-migrates across CPU models mid-run (model-
+    # tuned AOT code executed on the other model produced NaNs; see
+    # utils/cache.py).  Irrelevant for accelerator runs.
+    if cpu_fallback or os.environ.get(
+            "JAX_PLATFORMS", "").strip().lower() == "cpu":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+
     import jax
     import jax.numpy as jnp
     # the ambient environment may register a default accelerator

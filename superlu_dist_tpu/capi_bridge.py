@@ -1,0 +1,138 @@
+"""Marshaling bridge for the embedded-interpreter C ABI (csrc/
+slu_capi.cpp) — the TPU-native answer to the reference's Fortran
+binding layer (FORTRAN/superlu_c2f_dwrap.c:142, superlu_mod.f90:11):
+where the reference wraps its C structs in opaque integer handles for
+F90, this build wraps the Python driver in a C ABI by embedding
+CPython, so C/Fortran hosts call the same gssvx pipeline Python does.
+
+All functions take RAW POINTER ADDRESSES as integers (the C side
+passes them straight through); numpy wraps them zero-copy with
+np.ctypeslib.  Dense blocks are COLUMN-major (n, nrhs) — the Fortran
+layout, matching the reference's F90 interface expectations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_HANDLES: dict = {}
+_NEXT = [1]
+
+
+def _arr(addr: int, n: int, ctype):
+    return np.ctypeslib.as_array(
+        ctypes.cast(int(addr), ctypes.POINTER(ctype)), shape=(int(n),))
+
+
+def _parse_options(spec: str):
+    """'key=value,key=value' -> Options.  Keys: colperm, rowperm,
+    refine, trans, factor_dtype, refine_dtype, equil,
+    replace_tiny_pivot (enum members by name; yes/no for the YesNo
+    knobs)."""
+    from .options import (ColPerm, IterRefine, Options, RowPerm, Trans,
+                          YesNo)
+    kw = {}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        k, _, v = item.partition("=")
+        k, v = k.strip().lower(), v.strip()
+        if k == "colperm":
+            kw["col_perm"] = ColPerm[v.upper()]
+        elif k == "rowperm":
+            kw["row_perm"] = RowPerm[v.upper()]
+        elif k == "refine":
+            kw["iter_refine"] = IterRefine[v.upper()]
+        elif k == "trans":
+            kw["trans"] = Trans[v.upper()]
+        elif k in ("factor_dtype", "refine_dtype"):
+            kw[k] = v
+        elif k == "equil":
+            kw["equil"] = YesNo.YES if v.lower() in ("yes", "1", "true") \
+                else YesNo.NO
+        elif k == "replace_tiny_pivot":
+            kw["replace_tiny_pivot"] = (
+                YesNo.YES if v.lower() in ("yes", "1", "true")
+                else YesNo.NO)
+        elif k == "backend":
+            kw["_backend"] = v          # consumed below, not an Option
+        else:
+            raise ValueError(f"unknown option key {k!r}")
+    backend = kw.pop("_backend", "auto")
+    return Options(**kw), backend
+
+
+def _csr(n, nnz, indptr_addr, indices_addr, values_addr):
+    from .sparse import CSRMatrix
+    indptr = _arr(indptr_addr, n + 1, ctypes.c_int64).copy()
+    indices = _arr(indices_addr, nnz, ctypes.c_int64).copy()
+    values = _arr(values_addr, nnz, ctypes.c_double).copy()
+    return CSRMatrix(m=int(n), n=int(n), indptr=indptr,
+                     indices=indices, data=values)
+
+
+def _b_colmajor(addr, n, nrhs):
+    flat = _arr(addr, n * nrhs, ctypes.c_double)
+    return flat.reshape(int(nrhs), int(n)).T.copy()  # (n, nrhs)
+
+
+def _write_colmajor(addr, x):
+    n, nrhs = x.shape
+    out = _arr(addr, n * nrhs, ctypes.c_double)
+    out[:] = np.asarray(x, dtype=np.float64).T.reshape(-1)
+
+
+def solve(n, nnz, indptr_addr, indices_addr, values_addr,
+          nrhs, b_addr, x_addr, berr_addr, options_str) -> int:
+    """One-call driver (f_pdgssvx analog): factor + solve + refine."""
+    from .models.gssvx import gssvx
+    opts, backend = _parse_options(options_str)
+    a = _csr(n, nnz, indptr_addr, indices_addr, values_addr)
+    b = _b_colmajor(b_addr, n, nrhs)
+    x, lu, stats = gssvx(opts, a, b, backend=backend)
+    _write_colmajor(x_addr, x if x.ndim == 2 else x[:, None])
+    if berr_addr:
+        _arr(berr_addr, 1, ctypes.c_double)[0] = float(stats.berr)
+    return 0
+
+
+def factorize(n, nnz, indptr_addr, indices_addr, values_addr,
+              options_str) -> int:
+    """Opaque-handle factorization (the F90 LUstruct handle pattern).
+    Returns a positive handle id."""
+    from .models.gssvx import factorize as _factorize
+    opts, backend = _parse_options(options_str)
+    a = _csr(n, nnz, indptr_addr, indices_addr, values_addr)
+    lu = _factorize(a, opts, backend=backend)
+    h = _NEXT[0]
+    _NEXT[0] += 1
+    _HANDLES[h] = lu
+    return h
+
+
+def solve_factored(handle, nrhs, b_addr, x_addr, trans) -> int:
+    import dataclasses
+
+    from .models.gssvx import solve as _solve
+    from .options import Trans
+    lu = _HANDLES[int(handle)]
+    # throwaway copy (the gssvx CONJ-path pattern): the persistent
+    # handle's state must not change per call
+    want = Trans.TRANS if int(trans) else Trans.NOTRANS
+    lu_t = dataclasses.replace(
+        lu, options=lu.effective_options.replace(trans=want))
+    n = lu.plan.n
+    b = _b_colmajor(b_addr, n, nrhs)
+    x = _solve(lu_t, b)
+    # keep any refinement-operand cache the solve built on the copy
+    lu.refine_cache = lu_t.refine_cache
+    _write_colmajor(x_addr, x if x.ndim == 2 else x[:, None])
+    return 0
+
+
+def free(handle) -> int:
+    _HANDLES.pop(int(handle), None)
+    return 0

@@ -179,10 +179,12 @@ def _solve_distributed(a, b, opts, args, stats):
     if getattr(args, "stats", False):
         from ..parallel.factor_dist import measure_comm
         import numpy as _np
-        # re-state the prediction at the ACTUAL nrhs so the
-        # side-by-side report compares like with like
+        # re-state the prediction at the ACTUAL nrhs and the EFFECTIVE
+        # factor dtype (complex systems promote, lu.device_lu.dtype is
+        # what the factors actually move) so the side-by-side report
+        # compares like with like
         stats.comm_predicted = lu.device_lu.schedule.comm_summary(
-            _np.dtype(opts.factor_dtype), nrhs=b.shape[1])
+            _np.dtype(lu.device_lu.dtype), nrhs=b.shape[1])
         stats.comm_measured = measure_comm(lu.device_lu,
                                            nrhs=b.shape[1])
     return x

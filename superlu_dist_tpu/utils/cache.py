@@ -13,8 +13,21 @@ already, but the per-host split is harmless there.
 from __future__ import annotations
 
 import hashlib
+import os
 import platform
 import re
+
+
+def ensure_portable_cpu_isa(flags: str) -> str:
+    """Append --xla_cpu_max_isa=AVX2 unless an ISA cap is already
+    present.  The single definition of the portability guard for
+    live-migrating VMs (model-tuned XLA:CPU artifacts executed on a
+    different host model produced NaN solves and a SIGSEGV); used by
+    tests/conftest.py, bench.py and the 16-device subprocess test."""
+    flags = flags or ""
+    if "xla_cpu_max_isa" not in flags:
+        flags = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    return flags
 
 
 def host_cache_dir(base: str) -> str:
@@ -24,8 +37,29 @@ def host_cache_dir(base: str) -> str:
     feature flags: XLA derives extra target features from the detected
     model (e.g. +prefer-no-scatter on some microarchitectures), so two
     hosts with identical cpuinfo flags can still produce mutually
-    unloadable (or worse, silently wrong) AOT objects."""
+    unloadable (or worse, silently wrong) AOT objects.
+
+    /proc/cpuinfo alone is NOT identity-proof under virtualization:
+    this round a VM migration served AOT artifacts with
+    +prefer-no-scatter tuning to a host whose real CPUID lacks it
+    (NaN solves + a SIGSEGV) while /proc/cpuinfo read the same.  The
+    fingerprint therefore leads with RAW CPUID leaves captured by the
+    native library (csrc slu_cpuid_words — the same instructions
+    LLVM's host detection executes), with /proc/cpuinfo as additional
+    salt and the platform strings as last resort."""
     parts = []
+    try:
+        from . import native
+        # never TRIGGER a native build from here (this runs at
+        # conftest/bench startup); use CPUID only when the built
+        # library is already current on disk
+        if native.so_is_current() and native.available():
+            w = native.cpuid_words()
+            if len(w):
+                parts.append("cpuid=" + ",".join(hex(int(x))
+                                                 for x in w))
+    except Exception:
+        pass
     try:
         with open("/proc/cpuinfo") as f:
             head = f.read().split("\n\n", 1)[0]
@@ -49,5 +83,13 @@ def host_cache_dir(base: str) -> str:
         # the flags fingerprint, hence kept as last resort only.
         parts = [platform.machine(), platform.processor(),
                  platform.platform()]
+    # artifacts compiled under an ISA cap (--xla_cpu_max_isa, the
+    # portability guard for live-migrating VMs) must not share a dir
+    # with full-ISA artifacts from the same host
+    import os
+    m = re.search(r"--xla_cpu_max_isa=(\S+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    if m:
+        parts.append(f"isa={m.group(1).lower()}")
     key = "|".join(parts)
     return f"{base}-{hashlib.sha1(key.encode()).hexdigest()[:12]}"

@@ -38,13 +38,26 @@ def _so_path() -> str:
                         "_slu_host.so")
 
 
+def so_is_current() -> bool:
+    """True when the built .so exists and is at least as new as its
+    source (the single freshness rule; also used by utils/cache.py to
+    decide whether CPUID can be read without triggering a build)."""
+    src = os.path.join(_repo_root(), "csrc", "slu_host.cpp")
+    out = _so_path()
+    try:
+        return os.path.exists(out) and (
+            not os.path.exists(src)
+            or os.path.getmtime(out) >= os.path.getmtime(src))
+    except OSError:
+        return False
+
+
 def _build() -> str | None:
     src = os.path.join(_repo_root(), "csrc", "slu_host.cpp")
     out = _so_path()
     if not os.path.exists(src):
         return None
-    if (os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)):
+    if so_is_current():
         return out
     tmp = f"{out}.{os.getpid()}.tmp"  # unique: concurrent builds race
     try:
@@ -111,8 +124,10 @@ def _load():
                                            ctypes.c_int64, _I64, _I64,
                                            _I64]
             lib.slu_supernodes.restype = ctypes.c_int64
+            lib.slu_cpuid_words.argtypes = [_I64, ctypes.c_int64]
+            lib.slu_cpuid_words.restype = ctypes.c_int64
             lib.slu_version.restype = ctypes.c_int64
-            assert lib.slu_version() == 5
+            assert lib.slu_version() == 6
             _lib = lib
         except (OSError, AssertionError, AttributeError):
             _failed = True
@@ -202,6 +217,16 @@ def mc64(n: int, colptr: np.ndarray, rowind: np.ndarray,
     if rc != 0:
         raise ValueError("structurally singular matrix (native mc64)")
     return perm, u, v
+
+
+def cpuid_words() -> np.ndarray:
+    """Raw CPUID leaf dump (x86; empty elsewhere) — the
+    virtualization-proof half of the compile-cache host fingerprint
+    (utils/cache.py)."""
+    lib = _load()
+    out = np.zeros(64, dtype=np.int64)
+    k = lib.slu_cpuid_words(out.ctypes.data_as(_I64), 64)
+    return out[:k]
 
 
 def hwpm(n: int, colptr: np.ndarray, rowind: np.ndarray,

@@ -12,22 +12,29 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Cap codegen at AVX2 so cached CPU executables are PORTABLE across
+# host models: this pool live-migrates VMs between CPU generations
+# mid-session, and model-tuned AOT artifacts (+prefer-no-scatter etc.)
+# executed on the other model produced NaN solves and a SIGSEGV
+# (cpu_aot_loader cross-model warnings).  Correctness tests don't
+# need AVX512 throughput.
+import sys  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from superlu_dist_tpu.utils.cache import (ensure_portable_cpu_isa,  # noqa: E402
+                                          host_cache_dir)
+
+os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(flags)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: the suite re-jits the same group programs
 # every run; caching cuts a cold 20-minute run to a few minutes.
-# The directory is fingerprinted by host CPU flags — XLA:CPU AOT
+# The directory is fingerprinted by host CPUID/flags — XLA:CPU AOT
 # entries from a different machine type misload (cpu_aot_loader
 # SIGILL/wrong-code warning; observed as flaky numerics).
-import sys  # noqa: E402
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-from superlu_dist_tpu.utils.cache import host_cache_dir  # noqa: E402
-
 jax.config.update("jax_compilation_cache_dir", host_cache_dir(
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")))

@@ -74,9 +74,12 @@ print("RESULT " + json.dumps(out))
 
 
 def test_16dev_invariance_and_coop_share():
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
+    # drop the 8-device forcing (the script sets 16 via jax.config)
+    # but keep codegen AVX2-portable like conftest (shared cache dir)
+    env["XLA_FLAGS"] = ensure_portable_cpu_isa("")
     env["SLU_COOP_MB"] = "32"  # engage coop on the small test fronts
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
