@@ -254,6 +254,19 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
             out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(hu[:w, :w])
         return out
     sched = lu.device_lu.schedule
+    panels = getattr(lu.device_lu, "panels", None)
+    if panels is not None:
+        # staged factors: per-group local U flats, offset 0
+        for g, p in zip(sched.groups, panels):
+            Ug = np.asarray(p[1])
+            for bg, s in zip(g.sup_pos, g.sup_ids):
+                b = int(bg)     # staged is single-device (d == 0)
+                panel = Ug[b * g.wb * g.mb:(b + 1) * g.wb
+                           * g.mb].reshape(g.wb, g.mb)
+                w = int(fp.w[s])
+                out[int(xsup[s]):int(xsup[s]) + w] = \
+                    np.diagonal(panel)[:w]
+        return out
     U_flat = np.asarray(lu.device_lu.U_flat)
     # dist flats are the ndev-concatenated device-major slabs; the
     # single-device case is ndev=1 of the same layout
@@ -280,8 +293,11 @@ def query_space(lu: LUFactorization) -> dict:
                    for p in s)
     else:
         d = lu.device_lu
-        held = (d.L_flat.size + d.U_flat.size + d.Li_flat.size
-                + d.Ui_flat.size) * itemsize
+        if hasattr(d, "held_bytes"):
+            held = d.held_bytes()
+        else:
+            held = (d.L_flat.size + d.U_flat.size + d.Li_flat.size
+                    + d.Ui_flat.size) * itemsize
     return {"lu_nnz": nnz, "lu_bytes": nnz * itemsize,
             "held_bytes": int(held)}
 

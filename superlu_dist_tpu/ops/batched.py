@@ -933,6 +933,151 @@ def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
 
 
 # --------------------------------------------------------------------
+# staged execution: one small jitted program PER GROUP instead of one
+# giant fused program.  XLA compile time is superlinear in program
+# size (measured: the 143-group k=64 fused program needs ~29 min on
+# this 1-core host; its groups compiled separately total minutes), so
+# past a group-count threshold the fused formulation loses more wall
+# clock to the compiler than it saves in dispatch.  The staged mode
+# trades ~one dispatch per group (µs) for bounded compiles: the
+# per-group jits are cached by shape signature (mb, wb, n_pad, index
+# lengths, ea_meta) and hit the persistent compilation cache across
+# runs.  Buffers stream through the groups by DONATION (verified
+# in-place on CPU and TPU), so no slab copies happen at dispatch
+# boundaries.  This is the audikw_1-scale path: the reference's
+# pdgstrf loop is O(nsupers) runtime and O(1) code size
+# (SRC/pdgstrf.c:1108); staged execution restores that asymptotic for
+# the compile while keeping every group body identical to the fused
+# path (_factor_group_impl / _fwd_group_impl / _bwd_group_impl).
+# --------------------------------------------------------------------
+
+def staged_enabled(sched) -> bool:
+    """Use per-group staged execution?  SLU_STAGED=1 forces on, =0
+    forces off; default: on past SLU_STAGED_MIN_GROUPS groups (the
+    regime where one fused program out-compiles its own runtime)."""
+    import os
+    v = os.environ.get("SLU_STAGED", "auto").strip().lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    try:
+        thresh = int(os.environ.get("SLU_STAGED_MIN_GROUPS", "96"))
+    except ValueError:
+        thresh = 96
+    return len(sched.groups) > thresh
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mb", "wb", "n_pad", "ea_meta"),
+                   donate_argnums=(0,))
+def _staged_factor_group(upd_buf, vals, thresh, a_src, a_dst, one_dst,
+                         ea_blocks, upd_off, *, mb: int, wb: int,
+                         n_pad: int, ea_meta: tuple):
+    """One factor group as its own program: group-LOCAL panel outputs
+    (offset 0 into exact-size flats) instead of writes into the global
+    slabs; `upd_buf` is donated so the extend-add buffer streams
+    through the group sequence in place."""
+    dtype = upd_buf.dtype
+    z32 = jnp.zeros((), jnp.int32)
+    with jax.default_matmul_precision("float32"):
+        return _factor_group_impl(
+            vals, upd_buf,
+            jnp.zeros(n_pad * mb * wb, dtype),
+            jnp.zeros(n_pad * wb * mb, dtype),
+            jnp.zeros(n_pad * wb * wb, dtype),
+            jnp.zeros(n_pad * wb * wb, dtype),
+            z32, z32, thresh, a_src, a_dst, one_dst, ea_blocks,
+            upd_off, z32, z32, z32, z32,
+            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mb", "wb", "n_pad", "cplx",
+                                    "kind"),
+                   donate_argnums=(0,))
+def _staged_sweep_group(X, pflat, iflat, col_idx, struct_idx, *,
+                        mb: int, wb: int, n_pad: int, cplx: bool,
+                        kind: str):
+    """One triangular-sweep group step (X donated; panels group-local,
+    offsets 0).  kind ∈ {fwd, bwd, fwdT, bwdT}."""
+    fn = {"fwd": _fwd_group_impl, "bwd": _bwd_group_impl,
+          "fwdT": _fwd_group_T_impl, "bwdT": _bwd_group_T_impl}[kind]
+    z32 = jnp.zeros((), jnp.int32)
+    with jax.default_matmul_precision("float32"):
+        return fn(X, pflat, iflat, col_idx, struct_idx, z32, z32,
+                  mb=mb, wb=wb, n_pad=n_pad, cplx=cplx)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype_str",))
+def _vals_ext(v, dtype_str: str):
+    dtype = np.dtype(dtype_str)
+    return jnp.concatenate([v.astype(dtype), jnp.zeros(1, dtype)])
+
+
+def _staged_factor_run(sched, vals, thresh_np, dtype):
+    """Python-dispatched group loop: returns (panels, tiny, nzero)
+    where panels[i] = (L, U, Li, Ui) group-local flats for group i and
+    the counters are device scalars (no per-group host sync — the
+    dispatch loop must stay ahead of device execution)."""
+    dtype = np.dtype(dtype)
+    rdt = _real_dtype(dtype)
+    vals_ext = _vals_ext(vals, dtype.str)
+    thresh = jnp.asarray(thresh_np, dtype=rdt)
+    upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+    panels = []
+    tiny = nzero = jnp.zeros((), jnp.int32)
+    for g in sched.groups:
+        a_src, a_dst, one_dst, ea_blocks, _, _ = g.dev(squeeze=True)
+        (upd_buf, L, U, Li, Ui, t, z) = _staged_factor_group(
+            upd_buf, vals_ext, thresh, a_src, a_dst, one_dst,
+            ea_blocks, jnp.asarray(g.upd_off_global, jnp.int64),
+            mb=g.mb, wb=g.wb, n_pad=g.n_loc, ea_meta=g.ea_meta)
+        panels.append((L, U, Li, Ui))
+        tiny = tiny + t
+        nzero = nzero + z
+    del upd_buf
+    return panels, int(tiny), int(nzero)
+
+
+def _staged_sweeps(sched, panels, bf, dtype, trans: bool):
+    """Forward+backward sweeps over the staged panels.  `bf` is the
+    RHS in factor ordering, shape (n, nrhs); returns X[:n]."""
+    dtype = np.dtype(dtype)
+    xdt = jnp.promote_types(dtype, bf.dtype)
+    cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+    n = sched.n
+    X = jnp.zeros((n + 1, bf.shape[1]), xdt)
+    X = X.at[:n, :].set(bf.astype(xdt))
+    X = _enc_jit(X, cplx)
+    # trans solves Mᵀ = Uᵀ·Lᵀ: forward on Uᵀ panels, backward on Lᵀ
+    fidx, fiidx = (1, 3) if trans else (0, 2)   # U,Ui / L,Li
+    bidx, biidx = (0, 2) if trans else (1, 3)
+    fkind, bkind = ("fwdT", "bwdT") if trans else ("fwd", "bwd")
+    for g, p in zip(sched.groups, panels):
+        _, _, _, _, ci, si = g.dev(squeeze=True)
+        X = _staged_sweep_group(X, p[fidx], p[fiidx], ci, si,
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                cplx=cplx, kind=fkind)
+    for g, p in zip(reversed(sched.groups), reversed(panels)):
+        _, _, _, _, ci, si = g.dev(squeeze=True)
+        X = _staged_sweep_group(X, p[bidx], p[biidx], ci, si,
+                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
+                                cplx=cplx, kind=bkind)
+    return _dec_jit(X, cplx)[:sched.n]
+
+
+@functools.partial(jax.jit, static_argnames=("cplx",))
+def _enc_jit(X, cplx):
+    return _enc(X, cplx)
+
+
+@functools.partial(jax.jit, static_argnames=("cplx",))
+def _dec_jit(X, cplx):
+    return _dec(X, cplx)
+
+
+# --------------------------------------------------------------------
 # single-device driver API
 # --------------------------------------------------------------------
 
@@ -948,6 +1093,23 @@ class DeviceLU:
     Li_flat: jnp.ndarray
     Ui_flat: jnp.ndarray
     tiny_pivots: int
+
+
+@dataclasses.dataclass
+class StagedLU:
+    """Device factor storage in per-group panels (staged execution).
+    Group-local flats concatenated in group order ARE the DeviceLU
+    slab layout (offsets are cumulative in group order), so consumers
+    that need the global view (get_diag_u) walk `panels` directly."""
+    plan: FactorPlan
+    schedule: BatchedSchedule
+    dtype: np.dtype
+    panels: list               # per group (L, U, Li, Ui) local flats
+    tiny_pivots: int
+
+    def held_bytes(self) -> int:
+        return sum(int(a.size) * np.dtype(self.dtype).itemsize
+                   for p in self.panels for a in p)
 
 
 def _phase_fns(sched, dtype, thresh_np):
@@ -982,37 +1144,51 @@ def _phase_fns(sched, dtype, thresh_np):
 
 
 def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
-                     dtype=np.float64) -> DeviceLU:
+                     dtype=np.float64):
     sched = get_schedule(plan, 1)
     dtype = np.dtype(dtype)
-    factor_fn, _ = _phase_fns(sched, dtype, _thresh_for(plan, dtype))
-    (L_flat, U_flat, Li_flat, Ui_flat, tiny,
-     nzero) = factor_fn(jnp.asarray(scaled_vals.astype(dtype)))
-
-    if int(nzero) > 0:
+    if staged_enabled(sched):
+        panels, tiny, nzero = _staged_factor_run(
+            sched, jnp.asarray(np.asarray(scaled_vals)),
+            _thresh_for(plan, dtype), dtype)
+        lu = StagedLU(plan=plan, schedule=sched, dtype=dtype,
+                      panels=panels, tiny_pivots=tiny)
+    else:
+        factor_fn, _ = _phase_fns(sched, dtype,
+                                  _thresh_for(plan, dtype))
+        (L_flat, U_flat, Li_flat, Ui_flat, tiny,
+         nzero) = factor_fn(jnp.asarray(scaled_vals.astype(dtype)))
+        nzero = int(nzero)
+        lu = DeviceLU(plan=plan, schedule=sched, dtype=dtype,
+                      L_flat=L_flat, U_flat=U_flat,
+                      Li_flat=Li_flat, Ui_flat=Ui_flat,
+                      tiny_pivots=int(tiny))
+    if nzero > 0:
         # reference semantics: U(i,i) == 0 with ReplaceTinyPivot=NO is
         # the info=i singularity signal (SRC/pdgstrf.c header); the
         # host backend raises for the same input
         raise ZeroDivisionError(
-            f"factorization hit {int(nzero)} exactly-zero pivot(s); "
+            f"factorization hit {nzero} exactly-zero pivot(s); "
             "the matrix is singular (enable replace_tiny_pivot to "
             "perturb instead)")
-    return DeviceLU(plan=plan, schedule=sched, dtype=dtype,
-                    L_flat=L_flat, U_flat=U_flat,
-                    Li_flat=Li_flat, Ui_flat=Ui_flat,
-                    tiny_pivots=int(tiny))
+    return lu
 
 
-def _solve_device_common(lu: DeviceLU, b: np.ndarray, trans: bool):
+def _solve_device_common(lu, b: np.ndarray, trans: bool):
     squeeze = b.ndim == 1
     bb = b[:, None] if squeeze else b
-    _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
-                             _thresh_for(lu.plan, lu.dtype))
     # promote rather than cast: a complex rhs against a real factor
     # must stay complex (matmuls promote; matches the host backend)
     xdt = np.promote_types(lu.dtype, bb.dtype)
-    X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
-                 jnp.asarray(bb.astype(xdt)), trans=trans)
+    if isinstance(lu, StagedLU):
+        X = _staged_sweeps(lu.schedule, lu.panels,
+                           jnp.asarray(bb.astype(xdt)), lu.dtype,
+                           trans)
+    else:
+        _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
+                                 _thresh_for(lu.plan, lu.dtype))
+        X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
+                     jnp.asarray(bb.astype(xdt)), trans=trans)
     out = np.asarray(X)
     return out[:, 0] if squeeze else out
 
@@ -1103,7 +1279,8 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
 def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                       refine_dtype=None,
                       max_steps: Optional[int] = None,
-                      mesh=None, axis=None):
+                      mesh=None, axis=None,
+                      staged: Optional[bool] = None):
     """Build `step(vals, b) -> (x, berr, steps, tiny, nzero)`: the
     ENTIRE pdgssvx numeric pipeline as ONE XLA program — scale +
     assemble + level-batched factorization in `dtype`, trisolve, then
@@ -1120,7 +1297,17 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     With `mesh` given the SAME program runs shard_map'd over the mesh:
     fronts partition across devices, ancestor updates ride all_gather,
     sweeps psum — multi-chip time-to-solution as one compiled step
-    (the pdgssvx3d-with-refinement contract)."""
+    (the pdgssvx3d-with-refinement contract).
+
+    `staged` (single-device only): None = auto (staged_enabled); True
+    forces per-group staged dispatch, False forces the one-program
+    formulation.  The staged step is a PYTHON function (host-driven
+    refinement loop, per-group programs) — it is NOT traceable, so
+    wrap-in-jit/vmap callers must pass staged=False.  staged=True
+    with mesh= is an error (mesh execution is always fused)."""
+    if staged and mesh is not None:
+        raise ValueError("staged=True is single-device only; mesh "
+                         "execution always uses the fused program")
     from .spmv import coo_spmv
 
     from ..options import IterRefine
@@ -1173,6 +1360,31 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         coo_cols=jnp.asarray(plan.coo_cols, dtype=idt),
     )
 
+    # ---- shared numerics pieces: ONE definition serves the fused
+    # trace and the staged host loop, so the two cannot diverge ----
+
+    def _scale_impl(vals):
+        return vals * ops["scale_fac"]
+
+    def _pre_impl(r):
+        """original-order residual -> factor-order sweep RHS (factor
+        precision, like the reference's psgsrfs)."""
+        return ((r * ops["row_scale"][:, None])
+                [ops["inv_final_row"]]).astype(dtype)
+
+    def _post_impl(y):
+        """factor-order sweep output -> original-order correction."""
+        return (y[ops["final_col"]].astype(rdt)
+                * ops["col_scale"][:, None])
+
+    def _resid_berr_impl(vals_r, abs_vals, b, xv):
+        ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r, xv, n)
+        r = b - ax
+        denom = coo_spmv(ops["coo_rows"], ops["coo_cols"],
+                         abs_vals, jnp.abs(xv), n) + jnp.abs(b)
+        denom = jnp.where(denom == 0, 1, denom)
+        return r, jnp.max(jnp.abs(r) / denom)
+
     def _factor(scaled_vals, per_group):
         # the group-loop drivers are factor_dist's — ONE implementation
         # serves the fused solver, the split dist pair, and the dist
@@ -1183,18 +1395,15 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         return list(out[:4]), out[4], out[5]
 
     def _solve_once(flats, r, per_group):
-        """r (original order, rdt) -> correction (original order, rdt);
-        sweeps run in factor precision like the reference's psgsrfs."""
+        """r (original order, rdt) -> correction (original order, rdt)."""
         from ..parallel.factor_dist import _solve_loop
-        bf = (r * ops["row_scale"][:, None])[ops["inv_final_row"]]
         solve_idx = [(t[4], t[5]) for t in per_group]
-        y = _solve_loop(sched, tuple(flats), bf.astype(dtype), dtype,
+        y = _solve_loop(sched, tuple(flats), _pre_impl(r), dtype,
                         solve_idx, axis, trans=False)
-        return (y[ops["final_col"]].astype(rdt)
-                * ops["col_scale"][:, None])
+        return _post_impl(y)
 
     def step_body(vals, b, per_group):
-        scaled = vals * ops["scale_fac"]
+        scaled = _scale_impl(vals)
         flats, tiny, nzero = _factor(scaled, per_group)
         if axis is not None:
             tiny = jax.lax.psum(tiny, axis)
@@ -1204,13 +1413,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         b = b.astype(rdt)
 
         def resid_berr(xv):
-            ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r,
-                          xv, n)
-            r = b - ax
-            denom = coo_spmv(ops["coo_rows"], ops["coo_cols"],
-                             abs_vals, jnp.abs(xv), n) + jnp.abs(b)
-            denom = jnp.where(denom == 0, 1, denom)
-            return r, jnp.max(jnp.abs(r) / denom)
+            return _resid_berr_impl(vals_r, abs_vals, b, xv)
 
         if max_steps <= 0:
             x = _solve_once(flats, b, per_group)
@@ -1255,6 +1458,66 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
              jnp.zeros((), jnp.bool_)))
         # steps counts loop iterations; the first is the base solve
         return x, berr, jnp.maximum(steps - 1, 0), tiny, nzero
+
+    if staged is None:
+        staged = staged_enabled(sched)
+    if mesh is None and staged:
+        # staged whole-pipeline step: identical contract and identical
+        # numerics policy (same group bodies, same refinement loop
+        # logic), but the factor/sweep groups dispatch as per-group
+        # programs and the refinement loop runs on the host — compile
+        # stays bounded at audikw_1 scale (see staged_enabled)
+        eps = float(np.finfo(rdt.char.lower()
+                             if rdt.kind == "c" else rdt).eps)
+
+        _scale = jax.jit(_scale_impl)
+        _pre = jax.jit(_pre_impl)
+        _post = jax.jit(_post_impl)
+        _resid_berr = jax.jit(_resid_berr_impl)
+        _axpy = jax.jit(lambda x, d: x + d)
+
+        def step(vals, b):
+            vals = jnp.asarray(vals)
+            panels, tiny, nzero = _staged_factor_run(
+                sched, _scale(vals), thresh_np, dtype)
+            vals_r = vals.astype(rdt)
+            abs_vals = jnp.abs(vals_r)
+            b = jnp.asarray(b).astype(rdt)
+
+            def solve_once(r):
+                y = _staged_sweeps(sched, panels, _pre(r), dtype,
+                                   trans=False)
+                return _post(y)
+
+            t32 = jnp.asarray(tiny, jnp.int32)
+            z32 = jnp.asarray(nzero, jnp.int32)
+            if max_steps <= 0:
+                x = solve_once(b)
+                _, berr = _resid_berr(vals_r, abs_vals, b, x)
+                return x, berr, jnp.zeros((), jnp.int32), t32, z32
+
+            # host mirror of the fused while_loop (same decisions)
+            x = jnp.zeros((n, b.shape[1]), rdt)
+            r, berr = b, np.inf
+            steps, stop = 0, False
+            while not stop and berr > eps:
+                d = solve_once(r)
+                x_new = _axpy(x, d)
+                r_new, berr_new = _resid_berr(vals_r, abs_vals, b,
+                                              x_new)
+                berr_new_f = float(berr_new)
+                first = steps == 0
+                improved = berr_new_f < berr * 0.5
+                if first or berr_new_f < berr:
+                    x, r, berr = x_new, r_new, berr_new_f
+                stop = ((not first and not improved)
+                        or steps + 1 >= max_steps + 1)
+                steps += 1
+            return (x, jnp.asarray(berr, _real_dtype(rdt)),
+                    jnp.asarray(max(steps - 1, 0), jnp.int32),
+                    t32, z32)
+
+        return step
 
     if mesh is None:
         per_group_const = [g.dev(squeeze=True) for g in sched.groups]

@@ -132,7 +132,10 @@ def test_batch_mode_vmap():
     import jax
     a = laplacian_2d(6)
     plan = plan_factorization(a, Options())
-    step = make_fused_solver(plan, dtype="float64", max_steps=2)
+    # vmap needs the traceable fused formulation, never the staged
+    # (Python-dispatched) one
+    step = make_fused_solver(plan, dtype="float64", max_steps=2,
+                             staged=False)
     B = 3
     rng = np.random.default_rng(7)
     vals = np.stack([a.data * (1.0 + 0.1 * i) for i in range(B)])

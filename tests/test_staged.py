@@ -1,0 +1,101 @@
+"""Staged (per-group program) execution mode.
+
+Past a group-count threshold the fused one-program formulation loses
+more wall-clock to XLA's superlinear compile than it saves in dispatch
+(measured: 143-group k=64 fused ~29 min on a 1-core host), so
+ops.batched dispatches each group as its own cached jitted program
+with donated buffers (staged_enabled).  These tests force the staged
+mode on small problems and pin equivalence with the fused/unfused
+paths — same group bodies, so results must agree to rounding.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from superlu_dist_tpu import Options, gssvx
+from superlu_dist_tpu.models.gssvx import factorize, get_diag_u, solve
+from superlu_dist_tpu.ops.batched import (StagedLU, factorize_device,
+                                          make_fused_solver,
+                                          staged_enabled)
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.utils.testmat import laplacian_2d, manufactured_rhs
+
+
+@pytest.fixture
+def force_staged(monkeypatch):
+    monkeypatch.setenv("SLU_STAGED", "1")
+
+
+def test_staged_enabled_threshold(monkeypatch):
+    class S:
+        groups = list(range(10))
+    monkeypatch.delenv("SLU_STAGED", raising=False)
+    monkeypatch.setenv("SLU_STAGED_MIN_GROUPS", "9")
+    assert staged_enabled(S())
+    monkeypatch.setenv("SLU_STAGED_MIN_GROUPS", "10")
+    assert not staged_enabled(S())
+    monkeypatch.setenv("SLU_STAGED", "1")
+    assert staged_enabled(S())
+    monkeypatch.setenv("SLU_STAGED", "0")
+    monkeypatch.setenv("SLU_STAGED_MIN_GROUPS", "1")
+    assert not staged_enabled(S())
+
+
+def test_staged_fused_solver_matches(force_staged):
+    a = laplacian_2d(10)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    xt, b = manufactured_rhs(a, nrhs=2)
+    step = make_fused_solver(plan, dtype="float32")
+    x, berr, steps, tiny, nzero = step(jnp.asarray(a.data),
+                                       jnp.asarray(b))
+    x = np.asarray(x)
+    assert np.linalg.norm(x - xt) / np.linalg.norm(xt) < 1e-12
+    assert float(berr) < 1e-14
+    assert int(nzero) == 0
+
+
+def test_staged_factorize_is_staged_and_solves(force_staged):
+    a = laplacian_2d(9)
+    rng = np.random.default_rng(3)
+    xt = rng.standard_normal((a.n, 3))
+    b = a.to_scipy() @ xt
+    x, lu, stats = gssvx(Options(), a, b, backend="jax")
+    assert isinstance(lu.device_lu, StagedLU)
+    assert np.linalg.norm(x - xt) / np.linalg.norm(xt) < 1e-12
+    # trans solve through the same staged panels
+    bt = a.to_scipy().T @ xt
+    from superlu_dist_tpu.options import Trans
+    xT = solve(lu.__class__(**{**lu.__dict__,
+                               "options": lu.effective_options.replace(
+                                   trans=Trans.TRANS)}), bt)
+    assert np.linalg.norm(xT - xt) / np.linalg.norm(xt) < 1e-12
+
+
+def test_staged_complex(force_staged):
+    a = laplacian_2d(6)
+    import scipy.sparse as sp
+    sc = a.to_scipy().astype(np.complex128)
+    sc = sc + 1j * sp.diags(np.linspace(0.1, 0.4, a.n))
+    from superlu_dist_tpu.sparse import csr_from_scipy
+    ac = csr_from_scipy(sc.tocsr())
+    rng = np.random.default_rng(5)
+    xt = (rng.standard_normal((ac.n, 1))
+          + 1j * rng.standard_normal((ac.n, 1)))
+    x, lu, _ = gssvx(Options(), ac, sc @ xt, backend="jax")
+    assert isinstance(lu.device_lu, StagedLU)
+    assert np.linalg.norm(x - xt) / np.linalg.norm(xt) < 1e-12
+
+
+def test_staged_get_diag_u_matches_unstaged(force_staged, monkeypatch):
+    a = laplacian_2d(8)
+    plan = plan_factorization(a, Options())
+    lu_s = factorize(a, plan=plan, backend="jax")
+    assert isinstance(lu_s.device_lu, StagedLU)
+    d_s = get_diag_u(lu_s)
+    monkeypatch.setenv("SLU_STAGED", "0")
+    lu_f = factorize(a, plan=plan, backend="jax")
+    assert not isinstance(lu_f.device_lu, StagedLU)
+    d_f = get_diag_u(lu_f)
+    np.testing.assert_allclose(d_s, d_f, rtol=1e-12)
