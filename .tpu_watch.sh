@@ -1,0 +1,14 @@
+#!/bin/bash
+# Probe the accelerator tunnel; when it answers, run the staged bench.
+log=/root/repo/.tpu_watch.log
+echo "watch start $(date)" >> $log
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "tunnel LIVE at $(date) (attempt $i)" >> $log
+    SLU_STAGED=1 timeout 2400 python /root/repo/bench.py >> $log 2>&1
+    echo "bench rc=$? $(date)" >> $log
+    exit 0
+  fi
+  sleep 180
+done
+echo "gave up $(date)" >> $log
