@@ -18,11 +18,27 @@ algorithm, SRC/pdgstrf3d.c).
 
 Double precision is first-class for a linear solver, so importing this
 package enables JAX x64 mode.
+
+It also pins the default matmul precision to "highest": on TPU the
+default f32 matmul is a single bf16 MXU pass (~3 decimal digits), which
+silently degrades the f32 factorization to bf16 class — measured
+err~2.3e-3 vs the f64 ground truth on hardware, versus ~1e-7 for true
+f32 (tools/pallas_ab.py) — and stalls the f64 iterative-refinement
+contract for conditioned matrices (cond·ε_factor must stay < 1,
+SURVEY.md §2.6).  Solvers sell accuracy classes, not matmul throughput;
+override with SLU_MATMUL_PREC=default|high|highest if you know better.
+No effect on CPU (native f32 there).
 """
+
+import os as _os
 
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+_prec = _os.environ.get("SLU_MATMUL_PREC", "highest")
+if _prec != "default":
+    _jax.config.update("jax_default_matmul_precision", _prec)
 
 from .options import (  # noqa: E402
     ColPerm,

@@ -73,8 +73,13 @@ def _newton_tri_inverse(T, *, lower: bool, unit: bool):
     # body, so unrolling only multiplies program size (compile time)
     # without enabling any fusion
     if steps > 0:
+        # int32 bounds: under jax_enable_x64 Python-int bounds make the
+        # induction variable int64, which Mosaic cannot lower when this
+        # helper is traced inside the Pallas kernel (its 64->32 scalar
+        # convert self-recurses)
         X = jax.lax.fori_loop(
-            0, steps, lambda _, X: X @ (2 * eye - A @ X), X)
+            jnp.int32(0), jnp.int32(steps),
+            lambda _, X: X @ (2 * eye - A @ X), X)
     if not unit:
         X = X / jnp.swapaxes(d, -1, -2)         # inv = inv(I+D⁻¹N)·D⁻¹
     return X

@@ -29,8 +29,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .dense_lu import _tiny_replace
-
 try:  # pallas is part of jax, but guard exotic builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -38,15 +36,41 @@ try:  # pallas is part of jax, but guard exotic builds
 except ImportError:  # pragma: no cover
     _HAVE_PALLAS = False
 
+try:
+    # the package enables jax_enable_x64 globally (f64 refinement),
+    # but the kernel must trace in 32-bit mode: weak Python literals
+    # (jnp.where(..., 0), jnp.eye's iota) otherwise enter the jaxpr as
+    # i64/f64 scalars, and Mosaic has no 64-bit lowering — its 64→32
+    # convert self-recurses and its layout pass fails ("failed to
+    # legalize func.return").  Private-API import, so guarded.
+    from jax._src.config import enable_x64 as _x64_setting
+except ImportError:  # pragma: no cover
+    import contextlib
+
+    def _x64_setting(_v):
+        return contextlib.nullcontext()
+
 
 def enabled(dtype) -> bool:
     """Use the Pallas kernel?  SLU_TPU_PALLAS=1 forces on (interpret
-    mode off-TPU), =0 forces off; default off pending hardware
-    validation.  Complex dtypes always use the XLA path (no complex in
-    Mosaic)."""
+    mode off-TPU), =0 forces off.
+
+    Default OFF — resolved by hardware measurement, not hope
+    (PALLAS_AB.json, tools/pallas_ab.py on TPU v5e, amortized in-jit
+    timing): the XLA fori_loop formulation is ~2x faster at every
+    bucket shape ≥ (wb=16, mb=32) (e.g. 44 vs 20 GFLOP/s at 512²) and
+    both paths sit at true-f32 accuracy vs the f64 ground truth
+    (~5e-7) under the package's "highest" matmul precision.  The
+    kernel wins only the µs-scale (8, 16) bucket (1.3x), which never
+    dominates a schedule.  Complex dtypes always use the XLA path (no
+    complex in Mosaic)."""
     if not _HAVE_PALLAS:
         return False
     if np.dtype(dtype).kind == "c":
+        return False
+    if np.dtype(dtype).itemsize == 8:
+        # f64: the kernel traces with x64 disabled and Mosaic has no
+        # 64-bit lowering — always the XLA path
         return False
     flag = os.environ.get("SLU_TPU_PALLAS", "0")
     return flag == "1"
@@ -60,6 +84,27 @@ _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 def usable(mb: int, dtype) -> bool:
     """Does one (mb × mb) front fit the kernel's VMEM working set?"""
     return 2 * mb * mb * np.dtype(dtype).itemsize <= _VMEM_BUDGET_BYTES
+
+
+def _tiny_replace_sel(piv, thresh, dtype):
+    """GESP tiny-pivot replacement, Mosaic-safe formulation: same
+    semantics as dense_lu._tiny_replace (|piv| < thresh →
+    sign(piv)·thresh; thresh == 0 disables and flags exact zeros) but
+    written as copysign-via-select + maximum and where-selected int32
+    counters.  The original's nested scalar-where chain combined with
+    bool→int32 counter casts trips a Mosaic layout-inference bug
+    ("failed to legalize func.return") when traced inside a fori_loop
+    on real hardware; this arithmetic form lowers cleanly."""
+    apiv = jnp.abs(piv)
+    one = jnp.ones((), jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    sgn = jnp.where(piv >= 0, jnp.ones((), dtype), -jnp.ones((), dtype))
+    newpiv = sgn * jnp.maximum(apiv, thresh)
+    is_tiny = apiv < thresh
+    was_tiny = jnp.where(is_tiny, one, zero)
+    was_zero = jnp.where((apiv == 0) & jnp.logical_not(is_tiny),
+                         one, zero)
+    return newpiv, was_tiny, was_zero
 
 
 def _pick_nb(wb: int, nb_max: int = 32) -> int:
@@ -82,7 +127,7 @@ def _unit_lower_inverse_newton(L, nb: int):
 
 
 def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
-                       *, wb: int, mb: int):
+                       *, wb: int, mb: int, nb: int):
     """Blocked right-looking partial LU of one front, VMEM-resident.
 
     Per nb-wide block: rank-1 panel elimination restricted to the
@@ -93,18 +138,25 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
     solves; results agree to rounding.)  The kb loop is
     Python-unrolled (static slices); only the nb rank-1 steps per
     block run as a fori_loop on the (mb, nb) panel, so VPU work is
-    O(wb·mb·nb) instead of the whole-front O(wb·mb²)."""
-    F = F_ref[0]
-    dtype = F.dtype
+    O(wb·mb·nb) instead of the whole-front O(wb·mb²).
+
+    The front lives in out_ref for the whole elimination and every
+    block update is a STATIC ref-slice store: Mosaic has no
+    dynamic_update_slice lowering, but static VMEM slice loads/stores
+    are native.  On real hardware every slice boundary (multiples of
+    nb) must be tile-aligned — lane offsets in multiples of 128 —
+    or Mosaic's backend aborts; the caller picks nb accordingly and
+    falls back to the column kernel when no aligned nb divides wb."""
+    out_ref[0] = F_ref[0]
+    dtype = F_ref.dtype
     thresh = thresh_ref[0, 0].astype(dtype)
-    nb = _pick_nb(wb)
     rows_m = jax.lax.broadcasted_iota(jnp.int32, (mb, 1), 0)
     cols_nb = jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1)
     tiny = jnp.zeros((), jnp.int32)
     nzero = jnp.zeros((), jnp.int32)
 
     for k0 in range(0, wb, nb):
-        panel = F[:, k0:k0 + nb]                        # (mb, nb)
+        panel = out_ref[0, :, k0:k0 + nb]               # (mb, nb)
 
         def t_step(t, carry, k0=k0):
             panel, tiny, nzero = carry
@@ -113,8 +165,8 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
             ck = jnp.sum(jnp.where(is_t, panel, 0), axis=1,
                          keepdims=True)                 # (mb, 1)
             piv = jnp.sum(jnp.where(rows_m == k, ck, 0))
-            piv, was_tiny, was_zero = _tiny_replace(piv, thresh,
-                                                    dtype)
+            piv, was_tiny, was_zero = _tiny_replace_sel(piv, thresh,
+                                                        dtype)
             below = rows_m > k
             scaled = jnp.where(below, ck / piv, ck)
             newcol = jnp.where(rows_m == k, piv, scaled)
@@ -128,23 +180,24 @@ def _lu_kernel_blocked(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref,
             panel = panel - upd
             return panel, tiny + was_tiny, nzero + was_zero
 
+        # int32 bounds: Python-int bounds become an int64 induction
+        # variable under jax_enable_x64, which Mosaic cannot lower
         panel, tiny, nzero = jax.lax.fori_loop(
-            0, nb, t_step, (panel, tiny, nzero))
-        F = jax.lax.dynamic_update_slice(F, panel, (0, k0))
+            jnp.int32(0), jnp.int32(nb), t_step, (panel, tiny, nzero))
+        out_ref[0, :, k0:k0 + nb] = panel
         rest = mb - k0 - nb
         if rest > 0:
             Inv = _unit_lower_inverse_newton(
                 panel[k0:k0 + nb, :], nb)
-            U12 = Inv @ F[k0:k0 + nb, k0 + nb:]         # (nb, rest)
-            L21 = panel[k0 + nb:, :]                    # (rest, nb)
-            F22 = F[k0 + nb:, k0 + nb:] - L21 @ U12
-            F = jax.lax.dynamic_update_slice(F, U12, (k0, k0 + nb))
-            F = jax.lax.dynamic_update_slice(F, F22,
-                                             (k0 + nb, k0 + nb))
+            U12 = Inv @ out_ref[0, k0:k0 + nb, k0 + nb:]  # (nb, rest)
+            L21 = panel[k0 + nb:, :]                      # (rest, nb)
+            out_ref[0, k0:k0 + nb, k0 + nb:] = U12
+            out_ref[0, k0 + nb:, k0 + nb:] = (
+                out_ref[0, k0 + nb:, k0 + nb:] - L21 @ U12)
 
-    out_ref[0] = F
-    tiny_ref[0, 0] = tiny
-    nzero_ref[0, 0] = nzero
+    i = pl.program_id(0)
+    tiny_ref[0, i] = tiny
+    nzero_ref[0, i] = nzero
 
 
 def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
@@ -154,6 +207,10 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
     thresh = thresh_ref[0, 0].astype(dtype)
     rows = jax.lax.broadcasted_iota(jnp.int32, (mb, mb), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (mb, mb), 1)
+    # narrow iotas built at shape (no value slicing: Mosaic cannot
+    # legalize width-1 lane extracts of vreg values)
+    rows_c = jax.lax.broadcasted_iota(jnp.int32, (mb, 1), 0)
+    cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, mb), 1)
 
     def col_step(k, carry):
         F, tiny, nzero = carry
@@ -162,22 +219,24 @@ def _lu_kernel(thresh_ref, F_ref, out_ref, tiny_ref, nzero_ref, *,
         # column/row k via mask-reduce (dynamic lane slicing is slow)
         ck = jnp.sum(jnp.where(is_k_col, F, 0), axis=1, keepdims=True)
         piv = jnp.sum(jnp.where(is_k_col & is_k_row, F, 0))
-        piv, was_tiny, was_zero = _tiny_replace(piv, thresh, dtype)
-        below = rows[:, :1] > k
+        piv, was_tiny, was_zero = _tiny_replace_sel(piv, thresh, dtype)
+        below = rows_c > k
         scaled = jnp.where(below, ck / piv, ck)
-        newcol = jnp.where(is_k_row[:, :1], piv, scaled)
+        newcol = jnp.where(rows_c == k, piv, scaled)
         F = jnp.where(is_k_col, newcol, F)
         rk = jnp.sum(jnp.where(is_k_row, F, 0), axis=0, keepdims=True)
         upd = jnp.where(below, scaled, 0) * jnp.where(
-            cols[:1, :] > k, rk, 0)
+            cols_r > k, rk, 0)
         F = F - upd
         return F, tiny + was_tiny, nzero + was_zero
 
     zero = jnp.zeros((), jnp.int32)
-    F, tiny, nzero = jax.lax.fori_loop(0, wb, col_step, (F, zero, zero))
+    F, tiny, nzero = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(wb), col_step, (F, zero, zero))
+    i = pl.program_id(0)
     out_ref[0] = F
-    tiny_ref[0, 0] = tiny
-    nzero_ref[0, 0] = nzero
+    tiny_ref[0, i] = tiny
+    nzero_ref[0, i] = nzero
 
 
 def partial_lu_batch_pallas(F, thresh, *, wb: int,
@@ -188,13 +247,20 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     thresh_arr = jnp.asarray(thresh, dtype=F.dtype).reshape(1, 1)
-    # blocked kernel (MXU TRSM/GEMM per nb-wide panel) by default;
-    # SLU_TPU_PALLAS_COLUMN=1 falls back to the per-column rank-1
-    # kernel for A/B comparison
-    if os.environ.get("SLU_TPU_PALLAS_COLUMN", "0") == "1":
+    # blocked kernel (MXU TRSM/GEMM per nb-wide panel) where its slice
+    # boundaries are expressible: any nb in interpret mode, 128-aligned
+    # nb on real hardware (Mosaic aborts on unaligned VMEM slice
+    # stores).  SLU_TPU_PALLAS_COLUMN=1 forces the per-column rank-1
+    # kernel for A/B comparison.
+    if interpret:
+        nb = _pick_nb(wb)
+    else:
+        nb = next((d for d in (256, 128) if wb % d == 0), 0)
+    if (os.environ.get("SLU_TPU_PALLAS_COLUMN", "0") == "1"
+            or nb == 0 or mb % 8 != 0):
         kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
     else:
-        kern = functools.partial(_lu_kernel_blocked, wb=wb, mb=mb)
+        kern = functools.partial(_lu_kernel_blocked, wb=wb, mb=mb, nb=nb)
     # Mosaic's lowering visitors recurse through the unrolled block
     # chain.  Under jit this call only binds the primitive — lowering
     # runs at compile time, after we return — so the raised limit must
@@ -212,7 +278,14 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
             "for deferred Mosaic lowering of the unrolled block chain",
             stacklevel=2)
         sys.setrecursionlimit(20000)
-    out, tiny, nzero = pl.pallas_call(
+    with _x64_setting(False):
+        out, tiny, nzero = _pallas_lu_call(kern, N, mb, F.dtype,
+                                           interpret)(thresh_arr, F)
+    return out, jnp.sum(tiny), jnp.sum(nzero)
+
+
+def _pallas_lu_call(kern, N, mb, dtype, interpret):
+    return pl.pallas_call(
         kern,
         grid=(N,),
         in_specs=[
@@ -222,16 +295,19 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
         ],
         out_specs=[
             pl.BlockSpec((1, mb, mb), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0),
+            # whole-array SMEM blocks (indexed by program_id inside the
+            # kernel): Mosaic's tile check rejects a (1, 1) block over
+            # an (N, 1) array even in SMEM — block dims must equal the
+            # array's, which (1, N) satisfies
+            pl.BlockSpec((1, N), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, N), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((N, mb, mb), F.dtype),
-            jax.ShapeDtypeStruct((N, 1), jnp.int32),
-            jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            jax.ShapeDtypeStruct((N, mb, mb), dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.int32),
+            jax.ShapeDtypeStruct((1, N), jnp.int32),
         ],
         interpret=interpret,
-    )(thresh_arr, F)
-    return out, jnp.sum(tiny), jnp.sum(nzero)
+    )

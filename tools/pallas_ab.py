@@ -7,34 +7,70 @@ elementwise agreement, and prints one JSON line per (mb, wb, N)
 config.  This is the measurement VERDICT round-1 item 3 asks for: the
 `SLU_TPU_PALLAS` default must resolve by hardware numbers, not hope.
 
-Run on the chip:   python tools/pallas_ab.py
+Run on the chip:   python tools/pallas_ab.py   (from the repo root)
 Run interpreted:   JAX_PLATFORMS=cpu python tools/pallas_ab.py  (slow)
+
+Agreement is judged against an f64 numpy ground truth, not mutually:
+the two formulations accumulate f32 rounding differently (on TPU the
+XLA path's MXU matmuls round differently again), so their mutual diff
+measures rounding, not correctness.  `agree` = the Pallas error is
+within 2x the XLA path's own distance from the f64 factorization.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the "XLA" arm calls partial_lu_batch, whose dispatch honors
+# SLU_TPU_PALLAS — with the flag exported the A/B would compare the
+# Pallas kernel against itself; pin it off for this process
+os.environ["SLU_TPU_PALLAS"] = "0"
+
 import jax
 import jax.numpy as jnp
 
 
-def time_fn(fn, *args, reps=5):
-    out = fn(*args)
-    jax.tree_util.tree_map(
-        lambda a: a.block_until_ready()
-        if hasattr(a, "block_until_ready") else a, out)
+def ref_partial_lu(F, wb):
+    """f64 unpivoted partial LU ground truth (leading wb columns)."""
+    F = F.astype(np.float64).copy()
+    for k in range(wb):
+        F[k + 1:, k] /= F[k, k]
+        F[k + 1:, k + 1:] -= np.outer(F[k + 1:, k], F[k, k + 1:])
+    return F
+
+
+_CHAIN = 8   # in-jit repetitions per dispatch
+
+
+def time_fn(fn, F, reps=4):
+    """Amortized per-op time: the accelerator tunnel has a ~200 ms
+    per-dispatch RPC floor that swamps ms-scale kernels, so the op is
+    CHAINED _CHAIN times inside ONE jitted program (each output front
+    feeds the next factorization — same shapes, sequential dependency
+    defeats DCE) and the chain's wall time is divided out."""
+    single = jax.jit(fn)
+    out = single(F)                      # correctness output (1 apply)
+    jax.block_until_ready(out)
+
+    def chain(F):
+        def body(c, _):
+            return fn(c)[0], None
+        return jax.lax.scan(body, F, None, length=_CHAIN)[0]
+
+    chained = jax.jit(chain)
+    jax.block_until_ready(chained(F))    # compile
     best = np.inf
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.tree_util.tree_map(
-            lambda a: a.block_until_ready()
-            if hasattr(a, "block_until_ready") else a, out)
+        jax.block_until_ready(chained(F))
         best = min(best, time.perf_counter() - t0)
-    return best, out
+    return best / _CHAIN, out
 
 
 def main():
@@ -61,11 +97,11 @@ def main():
         Fd = jnp.asarray(F)
         thresh = np.float32(1e-30)
 
-        xla = jax.jit(lambda F: partial_lu_batch(F, thresh, wb=wb))
+        xla = lambda F: partial_lu_batch(F, thresh, wb=wb)
         t_xla, (Fx, tx, zx) = time_fn(xla, Fd)
 
-        pal = jax.jit(lambda F: partial_lu_batch_pallas(
-            F, thresh, wb=wb, interpret=not on_tpu))
+        pal = lambda F: partial_lu_batch_pallas(
+            F, thresh, wb=wb, interpret=not on_tpu)
         try:
             t_pal, (Fp, tp, zp) = time_fn(pal, Fd)
         except Exception as e:
@@ -73,16 +109,25 @@ def main():
             print(json.dumps(results[-1]), flush=True)
             continue
 
-        # agreement on the factored panel region (trailing block is
-        # the Schur update; both formulations produce the same math)
-        d = np.abs(np.asarray(Fx) - np.asarray(Fp))
-        scale = np.abs(np.asarray(Fx)) + 1.0
-        rel = float((d / scale).max())
+        # accuracy of each path vs the f64 ground truth (first batch
+        # element is representative; full-batch truth is O(N·mb³) host
+        # work)
+        R = ref_partial_lu(F[0], wb)
+        scale = np.abs(R) + 1.0
+        err_x = float((np.abs(np.asarray(Fx)[0] - R) / scale).max())
+        err_p = float((np.abs(np.asarray(Fp)[0] - R) / scale).max())
+        # true flops of one batched partial LU (no padding correction:
+        # every front here is exactly (mb, mb) with wb live columns)
+        flops = N * sum((mb - k - 1) + 2 * (mb - k - 1) ** 2
+                        for k in range(wb))
         rec = dict(wb=wb, mb=mb, N=N,
                    t_xla_ms=round(t_xla * 1e3, 3),
                    t_pallas_ms=round(t_pal * 1e3, 3),
                    speedup=round(t_xla / t_pal, 3),
-                   max_rel_diff=rel, agree=bool(rel < 1e-4))
+                   gflops_xla=round(flops / t_xla / 1e9, 1),
+                   gflops_pallas=round(flops / t_pal / 1e9, 1),
+                   err_xla=err_x, err_pallas=err_p,
+                   agree=bool(err_p <= max(2.0 * err_x, 1e-5)))
         results.append(rec)
         print(json.dumps(rec), flush=True)
     wins = [r for r in results if r.get("agree") and r["speedup"] > 1.1]
