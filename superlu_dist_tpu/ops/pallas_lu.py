@@ -44,8 +44,11 @@ try:
     # convert self-recurses and its layout pass fails ("failed to
     # legalize func.return").  Private-API import, so guarded.
     from jax._src.config import enable_x64 as _x64_setting
+    _HAVE_X64_CTX = True
 except ImportError:  # pragma: no cover
     import contextlib
+
+    _HAVE_X64_CTX = False
 
     def _x64_setting(_v):
         return contextlib.nullcontext()
@@ -65,6 +68,11 @@ def enabled(dtype) -> bool:
     dominates a schedule.  Complex dtypes always use the XLA path (no
     complex in Mosaic)."""
     if not _HAVE_PALLAS:
+        return False
+    if not _HAVE_X64_CTX and jax.config.jax_enable_x64:
+        # without the x64-off tracing shim (private-API import failed)
+        # a hardware compile would hit the Mosaic 64-bit crash this
+        # module documents — use the XLA path instead of crashing
         return False
     if np.dtype(dtype).kind == "c":
         return False

@@ -1,0 +1,138 @@
+"""Multi-host plan distribution: compute the factorization plan once,
+ship it to every host.
+
+Reference analog: the distributed-memory preprocessing pair —
+parallel symbolic factorization (SRC/psymbfact.c:150) and ParMETIS
+column ordering (SRC/get_perm_c_parmetis.c:255).  The reference
+distributes those stages because each MPI rank holds only a slice of
+A and no rank could run them alone.  This build's input model is
+host-global (every host can see the assembled matrix), so the
+scalability problem the reference solves rank-by-rank is solved here
+by a different decomposition:
+
+  * WITHIN a host, the plan stages are native C++ with level-parallel
+    threading (csrc/slu_host.cpp: `slu_symbfact_create_par`,
+    `slu_ndorder` threaded recursion — the shared-memory collapse of
+    psymbfact's level waves);
+  * ACROSS hosts, the plan is computed ONCE (host 0) and broadcast as
+    bytes over the JAX process group — every other host pays network
+    transfer instead of recomputation, and all hosts are guaranteed
+    bit-identical schedules (the property psymbfact gets implicitly
+    from deterministic SPMD and this build must guarantee explicitly,
+    since threaded ordering heuristics may tie-break differently
+    across runs).
+
+The broadcast rides `jax.experimental.multihost_utils
+.broadcast_one_to_all`, the same process-group primitive jax uses for
+checkpoint coordination — no hand-rolled sockets (SURVEY.md §5.8:
+comm-backend mapping).
+
+Single-process runs degrade to a plain local plan (no device traffic),
+so the entry point is safe to call unconditionally.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+from ..plan.plan import FactorPlan, plan_factorization
+
+# wire format versioning: refuse to deserialize a plan produced by a
+# different schema (hosts on mismatched package versions must fail
+# loudly, not factor with inconsistent schedules)
+_WIRE_MAGIC = b"SLUTPLAN"
+_WIRE_VERSION = 1
+
+
+def serialize_plan(plan: FactorPlan) -> bytes:
+    """Plan -> bytes.  Pickle of host-side numpy/dataclass state with
+    a magic+version header; no device arrays are ever in a plan."""
+    buf = io.BytesIO()
+    buf.write(_WIRE_MAGIC)
+    buf.write(_WIRE_VERSION.to_bytes(4, "little"))
+    pickle.dump(plan, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def deserialize_plan(data: bytes) -> FactorPlan:
+    if data[:len(_WIRE_MAGIC)] != _WIRE_MAGIC:
+        raise ValueError("not a serialized FactorPlan (bad magic)")
+    ver = int.from_bytes(
+        data[len(_WIRE_MAGIC):len(_WIRE_MAGIC) + 4], "little")
+    if ver != _WIRE_VERSION:
+        raise ValueError(
+            f"serialized plan wire version {ver} != {_WIRE_VERSION}; "
+            "hosts must run the same superlu_dist_tpu version")
+    plan = pickle.loads(data[len(_WIRE_MAGIC) + 4:])
+    if not isinstance(plan, FactorPlan):
+        raise ValueError("payload is not a FactorPlan")
+    return plan
+
+
+def _broadcast_bytes(data: bytes | None, is_source: bool) -> bytes:
+    """Broadcast a byte string from process 0 to all processes.
+    Two-phase (length, then padded payload) because
+    broadcast_one_to_all requires identical shapes on every host."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        assert data is not None
+        return data
+    nbytes = np.array([len(data) if is_source else 0], np.int64)
+    nbytes = multihost_utils.broadcast_one_to_all(nbytes)
+    n = int(nbytes[0])
+    payload = np.zeros(n, np.uint8)
+    if is_source:
+        payload = np.frombuffer(data, np.uint8, count=n).copy()
+    payload = multihost_utils.broadcast_one_to_all(payload)
+    return payload.tobytes()
+
+
+def plan_factorization_multihost(a, options=None, *, stats=None,
+                                 autotune: bool | None = None
+                                 ) -> FactorPlan:
+    """plan_factorization, computed on process 0 and broadcast.
+
+    Every host calls this with the same (a, options); host 0 runs the
+    full preprocessing pipeline (equil -> rowperm -> colperm -> etree
+    -> symbfact -> frontal maps), the rest receive the finished plan.
+    On a single process this is exactly plan_factorization (autotune
+    defaults to None = defer to options.autotune, same as there).
+
+    The guarantee that matters downstream: all hosts hold
+    BIT-IDENTICAL schedules, so the pjit'd factor program they each
+    trace is the same program — the multi-host SPMD contract
+    (grid.gridinit_multihost docstring).
+
+    Failure contract: if planning raises on process 0, the exception's
+    text is broadcast in the payload slot and EVERY host raises — a
+    one-sided raise would leave the other hosts deadlocked inside the
+    collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return plan_factorization(a, options, stats=stats,
+                                  autotune=autotune)
+    is_source = jax.process_index() == 0
+    blob = None
+    plan = None
+    if is_source:
+        try:
+            plan = plan_factorization(a, options, stats=stats,
+                                      autotune=autotune)
+            blob = b"\x00" + serialize_plan(plan)
+        except Exception as e:  # ship the failure, don't deadlock
+            blob = b"\x01" + repr(e).encode("utf-8", "replace")
+    blob = _broadcast_bytes(blob, is_source)
+    if blob[:1] == b"\x01":
+        raise RuntimeError(
+            "plan_factorization failed on process 0: "
+            + blob[1:].decode("utf-8", "replace"))
+    if is_source:
+        return plan
+    return deserialize_plan(blob[1:])

@@ -1,0 +1,91 @@
+"""Multi-host plan distribution (parallel/multihost.py) — the
+psymbfact/ParMETIS slot (SRC/psymbfact.c:150,
+SRC/get_perm_c_parmetis.c:255): plan once on host 0, broadcast bytes.
+True multi-process broadcast needs multiple hosts; what is pinned
+here is the wire format (round-trip bit-identity), the version gate,
+and that a deserialized plan drives the solver to the same answer."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.parallel.multihost import (
+    _WIRE_MAGIC, deserialize_plan, plan_factorization_multihost,
+    serialize_plan)
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.sparse import csr_from_scipy
+
+
+def _testmat(m=20):
+    t = sp.diags([-1.0, 2.3, -1.1], [-1, 0, 1], shape=(m, m))
+    return csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+
+
+def _assert_plans_equal(p, q):
+    """Bit-identity of every array field, recursively."""
+    def eq(x, y, path):
+        if isinstance(x, np.ndarray):
+            assert x.dtype == y.dtype and x.shape == y.shape, path
+            assert np.array_equal(x, y), path
+        elif dataclasses.is_dataclass(x) and not isinstance(x, type):
+            for f in dataclasses.fields(x):
+                eq(getattr(x, f.name), getattr(y, f.name),
+                   f"{path}.{f.name}")
+        elif isinstance(x, (list, tuple)):
+            assert len(x) == len(y), path
+            for i, (a, b) in enumerate(zip(x, y)):
+                eq(a, b, f"{path}[{i}]")
+        elif isinstance(x, dict):
+            assert x.keys() == y.keys(), path
+            for k in x:
+                eq(x[k], y[k], f"{path}[{k}]")
+        else:
+            assert x == y, path
+    eq(p, q, "plan")
+
+
+def test_wire_roundtrip_bit_identical():
+    a = _testmat()
+    plan = plan_factorization(a, Options())
+    blob = serialize_plan(plan)
+    assert blob[:len(_WIRE_MAGIC)] == _WIRE_MAGIC
+    plan2 = deserialize_plan(blob)
+    _assert_plans_equal(plan, plan2)
+
+
+def test_wire_version_gate():
+    a = _testmat(6)
+    blob = bytearray(serialize_plan(plan_factorization(a, Options())))
+    with pytest.raises(ValueError, match="magic"):
+        deserialize_plan(b"XX" + bytes(blob)[2:])
+    bad = bytes(blob[:len(_WIRE_MAGIC)]) + (99).to_bytes(4, "little") \
+        + bytes(blob[len(_WIRE_MAGIC) + 4:])
+    with pytest.raises(ValueError, match="version"):
+        deserialize_plan(bad)
+
+
+def test_deserialized_plan_solves():
+    """A received plan must drive the device solver end-to-end."""
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    import jax.numpy as jnp
+    a = _testmat()
+    rng = np.random.default_rng(0)
+    xtrue = rng.standard_normal(a.n)
+    plan = deserialize_plan(serialize_plan(
+        plan_factorization(a, Options(factor_dtype="float32"))))
+    step = make_fused_solver(plan, dtype="float32")
+    x, berr, steps, tiny, nzero = step(
+        jnp.asarray(a.data), jnp.asarray((a.to_scipy() @ xtrue)[:, None]))
+    relerr = np.linalg.norm(np.asarray(x)[:, 0] - xtrue) \
+        / np.linalg.norm(xtrue)
+    assert relerr < 1e-12
+
+
+def test_single_process_degenerates_to_local_plan():
+    a = _testmat()
+    plan = plan_factorization_multihost(a, Options())
+    ref = plan_factorization(a, Options())
+    _assert_plans_equal(plan, ref)

@@ -1,0 +1,73 @@
+"""A/B/C the matmul-precision default on the ambient device.
+
+The package pins jax_default_matmul_precision="highest" (see
+__init__.py: TPU's default f32 matmul is one bf16 pass and lands the
+factor at bf16 class, ~2.3e-3 from the f64 truth).  "high" is the
+middle rung — 3 bf16 passes, roughly tf32-class, ~2x the matmul
+throughput of "highest" (6 passes) on the MXU.  This tool measures
+what each rung actually delivers END-TO-END on the fused solver:
+factor-only residual class, refinement steps to f64 accuracy, and
+steady-state time — the data for choosing the default.
+
+Each precision runs in a SUBPROCESS (the setting is applied at package
+import); one JSON line per rung on stdout.
+
+Run on the chip:  python tools/prec_ab.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import json, os, time
+import numpy as np
+import jax, jax.numpy as jnp
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.ops.batched import make_fused_solver
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.utils.testmat import laplacian_3d, manufactured_rhs
+
+k = int(os.environ.get("SLU_PREC_AB_K", "24"))
+a = laplacian_3d(k)
+xtrue, b = manufactured_rhs(a, nrhs=1)
+plan = plan_factorization(a, slu.Options(factor_dtype="float32"))
+step = make_fused_solver(plan, dtype="float32")
+vals = jnp.asarray(a.data)
+bb = jnp.asarray(b[:, None])
+x, berr, steps, tiny, nzero = step(vals, bb)
+jax.block_until_ready(x)
+best = np.inf
+for _ in range(3):
+    t0 = time.perf_counter()
+    x, berr, steps, tiny, nzero = step(vals, bb)
+    jax.block_until_ready(x)
+    best = min(best, time.perf_counter() - t0)
+relerr = float(np.linalg.norm(np.asarray(x)[:, 0] - xtrue)
+               / np.linalg.norm(xtrue))
+print(json.dumps({
+    "precision": os.environ.get("SLU_MATMUL_PREC", "highest"),
+    "n": a.n, "platform": jax.devices()[0].platform,
+    "refine_steps": int(steps), "berr": float(berr),
+    "relerr": relerr, "best_s": round(best, 4),
+    "gflops": round(plan.factor_flops / best / 1e9, 2),
+}))
+"""
+
+
+def main():
+    for prec in ("default", "high", "highest"):
+        env = dict(os.environ, SLU_MATMUL_PREC=prec)
+        r = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                           capture_output=True, text=True, timeout=3600)
+        line = [l for l in r.stdout.splitlines() if l.startswith("{")]
+        if line:
+            print(line[-1], flush=True)
+        else:
+            print(json.dumps({"precision": prec,
+                              "error": r.stderr[-300:]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
