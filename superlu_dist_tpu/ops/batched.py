@@ -697,6 +697,13 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int):
         st = st.reshape(-1)
         db = db.reshape(-1)
         ps = ps.reshape(-1, ps.shape[-1])
+        if upd_buf.size > np.iinfo(np.dtype(so.dtype)).max:
+            # audikw_1-class slabs pass 2^31 elements: jax's gather
+            # must represent the ARRAY SIZE in the index dtype (wrap
+            # normalization), so a >2 GiB-element upd_buf needs int64
+            # source indices even when this group's own span is small
+            so = so.astype(jnp.int64)
+            st = st.astype(jnp.int64)
 
         def add_chunk(Ff, so, st, db, ps):
             ai = jnp.arange(rc_b, dtype=so.dtype)

@@ -41,18 +41,27 @@ import numpy as np
 from ..plan.plan import FactorPlan, plan_factorization
 
 # wire format versioning: refuse to deserialize a plan produced by a
-# different schema (hosts on mismatched package versions must fail
-# loudly, not factor with inconsistent schedules)
+# different package version (the payload is a pickle coupled to
+# FactorPlan's class layout, which can change with ANY release, so the
+# gate compares the package __version__ itself — a hand-bumped schema
+# constant would silently go stale)
 _WIRE_MAGIC = b"SLUTPLAN"
-_WIRE_VERSION = 1
+
+
+def _wire_version() -> bytes:
+    from .. import __version__
+    return __version__.encode("ascii")
 
 
 def serialize_plan(plan: FactorPlan) -> bytes:
     """Plan -> bytes.  Pickle of host-side numpy/dataclass state with
-    a magic+version header; no device arrays are ever in a plan."""
+    a magic + package-version header; no device arrays are ever in a
+    plan."""
+    ver = _wire_version()
     buf = io.BytesIO()
     buf.write(_WIRE_MAGIC)
-    buf.write(_WIRE_VERSION.to_bytes(4, "little"))
+    buf.write(len(ver).to_bytes(4, "little"))
+    buf.write(ver)
     pickle.dump(plan, buf, protocol=pickle.HIGHEST_PROTOCOL)
     return buf.getvalue()
 
@@ -60,13 +69,15 @@ def serialize_plan(plan: FactorPlan) -> bytes:
 def deserialize_plan(data: bytes) -> FactorPlan:
     if data[:len(_WIRE_MAGIC)] != _WIRE_MAGIC:
         raise ValueError("not a serialized FactorPlan (bad magic)")
-    ver = int.from_bytes(
-        data[len(_WIRE_MAGIC):len(_WIRE_MAGIC) + 4], "little")
-    if ver != _WIRE_VERSION:
+    off = len(_WIRE_MAGIC)
+    vlen = int.from_bytes(data[off:off + 4], "little")
+    ver = data[off + 4:off + 4 + vlen]
+    if ver != _wire_version():
         raise ValueError(
-            f"serialized plan wire version {ver} != {_WIRE_VERSION}; "
-            "hosts must run the same superlu_dist_tpu version")
-    plan = pickle.loads(data[len(_WIRE_MAGIC) + 4:])
+            f"serialized plan version {ver.decode('ascii', 'replace')}"
+            f" != local {_wire_version().decode('ascii')}; hosts must "
+            "run the same superlu_dist_tpu version")
+    plan = pickle.loads(data[off + 4 + vlen:])
     if not isinstance(plan, FactorPlan):
         raise ValueError("payload is not a FactorPlan")
     return plan

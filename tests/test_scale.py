@@ -60,3 +60,30 @@ def test_slab_reuse_actually_reuses():
     sched = get_schedule(plan, 1)
     slab_sum = sum(g.n_loc * (g.mb - g.wb) ** 2 for g in sched.groups)
     assert sched.upd_total < slab_sum, "no slab reuse happened"
+
+
+def test_extend_add_indexes_huge_slab():
+    """audikw_1-class update slabs pass 2^31 elements; jax's gather
+    needs the index dtype to represent the ARRAY SIZE (wrap
+    normalization), so int32 source offsets must upcast at trace time
+    even when the group's own span is small.  Trace-only via
+    eval_shape — no 8 GiB allocation (found by tools/compile_scale.py
+    at K=100: OverflowError 5516008065 out of bounds for int32)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from superlu_dist_tpu.ops.batched import _ea_add
+
+    mb, n_pad, rc_b, K = 8, 2, 4, 3
+    big = 2**31 + 128          # slab longer than int32 can address
+    ea_meta = ((rc_b, K, K),)
+    ea_blocks = ((jnp.zeros(K, jnp.int32), jnp.ones(K, jnp.int32),
+                  jnp.zeros(K, jnp.int32),
+                  jnp.zeros((K, rc_b), jnp.int32)),)
+    out = jax.eval_shape(
+        functools.partial(_ea_add, ea_meta=ea_meta, mb=mb,
+                          n_pad=n_pad),
+        jax.ShapeDtypeStruct((n_pad * mb * mb,), jnp.float32),
+        jax.ShapeDtypeStruct((big,), jnp.float32),
+        ea_blocks)
+    assert out.shape == (n_pad * mb * mb,)

@@ -1,0 +1,104 @@
+"""Prove compile-boundedness at audikw_1 scale without the memory.
+
+VERDICT round-1 item 4: the fused one-program formulation Python-
+inlines every (level, bucket) group, so compile cost grows with tree
+depth; staged mode (ops/batched.py `staged_enabled`) replaces it with
+one cached jitted program per DISTINCT group signature.  This tool
+measures the thing that actually bounds staged compile at n≈10⁶ —
+the signature population and the wall-clock to AOT-compile all of it
+— WITHOUT allocating the ~34.5 GB of factor slabs a real K=100
+factorization needs (compile works from ShapeDtypeStructs).
+
+Prints one JSON line:
+  {k, n, groups, factor_signatures, sweep_signatures, plan_s,
+   schedule_s, compile_s, platform}
+
+Run:  python tools/compile_scale.py          (SLU_SCALE_K=100 default)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops import batched as B
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    k = int(os.environ.get("SLU_SCALE_K", "100"))
+    dtype = np.dtype(np.float32)
+    rdt = B._real_dtype(dtype)
+
+    t0 = time.perf_counter()
+    a = laplacian_3d(k)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    t_plan = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sched = B.build_schedule(plan, ndev=1)
+    t_sched = time.perf_counter() - t0
+
+    # distinct STATIC signatures: what the staged jit cache is keyed
+    # by, plus the dynamic-operand shapes (index-array lengths) that
+    # also key the executable
+    def sds(x):
+        x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    fsigs, ssigs = {}, {}
+    for g in sched.groups:
+        a_src, a_dst, one_dst, ea_blocks, ci, si = g.dev(squeeze=True)
+        ea_shapes = tuple(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: np.shape(x), ea_blocks)))
+        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, a_src.shape,
+                a_dst.shape, one_dst.shape, ea_shapes)
+        fsigs.setdefault(fkey, g)
+        skey = (g.mb, g.wb, g.n_loc, ci.shape, si.shape)
+        ssigs.setdefault(skey, g)
+
+    t0 = time.perf_counter()
+    for (mb, wb, n_pad, ea_meta, *_), g in fsigs.items():
+        a_src, a_dst, one_dst, ea_blocks, _, _ = g.dev(squeeze=True)
+        ea_blocks = jax.tree_util.tree_map(sds, ea_blocks)
+        B._staged_factor_group.lower(
+            jax.ShapeDtypeStruct((sched.upd_total + 1,), dtype),
+            jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
+            jax.ShapeDtypeStruct((), rdt),
+            sds(a_src), sds(a_dst), sds(one_dst), ea_blocks,
+            jax.ShapeDtypeStruct((), np.int64),
+            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta).compile()
+    nrhs = 1
+    for (mb, wb, n_pad, ci_s, si_s), g in ssigs.items():
+        B._staged_sweep_group.lower(
+            jax.ShapeDtypeStruct((sched.n + 1, nrhs), dtype),
+            jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
+            jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
+            jax.ShapeDtypeStruct(ci_s, np.int32),
+            jax.ShapeDtypeStruct(si_s, np.int32),
+            mb=mb, wb=wb, n_pad=n_pad, cplx=False,
+            kind="fwd").compile()
+    t_compile = time.perf_counter() - t0
+
+    print(json.dumps({
+        "k": k, "n": a.n, "groups": len(sched.groups),
+        "factor_signatures": len(fsigs),
+        "sweep_signatures": len(ssigs),
+        "plan_s": round(t_plan, 1), "schedule_s": round(t_sched, 1),
+        "compile_s": round(t_compile, 1),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
