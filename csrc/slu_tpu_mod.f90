@@ -1,0 +1,90 @@
+! Fortran interface to the TPU-native sparse direct solver.
+!
+! The Fortran-90 binding slot of the reference (FORTRAN/
+! superlu_mod.f90:1, FORTRAN/superlu_c2f_dwrap.c:142): where the
+! reference hand-writes ~2.6k lines of C wrappers marshalling MPI
+! communicators and opaque struct handles into f90, this build's
+! C ABI (slu_capi.cpp) is ISO_C_BINDING-clean by construction —
+! int64/double/char* only — so the entire binding is this one
+! declarative interface module.  Link against libslu_tpu_c.so
+! (`make libslu_tpu_c.so` in csrc/).
+!
+! Matrix format: CSR with 0-BASED int64 indptr/indices (convert
+! 1-based Fortran sparse structures by subtracting 1).  Dense blocks
+! b/x are column-major (n, nrhs) — the natural Fortran layout.
+! Options string: "key=value,key=value" (colperm=, rowperm=, refine=,
+! trans=, factor_dtype=, equil=, backend=); "" for defaults.
+!
+! Usage (the f_5x5-style flow, see f_demo.f90):
+!   ierr = slu_tpu_init(c_repo_path, 0_c_int64_t)
+!   ierr = slu_tpu_solve(n, nnz, indptr, indices, values, nrhs, b, x,
+!                        berr, c_options)
+!   handle = slu_tpu_factorize(...)        ! Fact-reuse ladder
+!   ierr = slu_tpu_solve_factored(handle, nrhs, b2, x2, 0_c_int64_t)
+!   ierr = slu_tpu_free(handle)
+
+module slu_tpu_mod
+  use iso_c_binding, only: c_int64_t, c_double, c_char, c_ptr
+  implicit none
+
+  interface
+
+    ! Initialize the embedded runtime; repo_path is prepended to the
+    ! module search path (pass the superlu_dist_tpu checkout or ""
+    ! if installed); force_cpu /= 0 pins the CPU backend.
+    integer(c_int64_t) function slu_tpu_init(repo_path, force_cpu) &
+        bind(c, name="slu_tpu_init")
+      import :: c_int64_t, c_char
+      character(kind=c_char), dimension(*), intent(in) :: repo_path
+      integer(c_int64_t), value :: force_cpu
+    end function slu_tpu_init
+
+    ! One-call expert driver (the f_pdgssvx analog): factor + solve +
+    ! iterative refinement.  berr receives the componentwise backward
+    ! error (pass a length-1 array).
+    integer(c_int64_t) function slu_tpu_solve(n, nnz, indptr, &
+        indices, values, nrhs, b, x, berr, options) &
+        bind(c, name="slu_tpu_solve")
+      import :: c_int64_t, c_double, c_char
+      integer(c_int64_t), value :: n, nnz, nrhs
+      integer(c_int64_t), dimension(*), intent(in) :: indptr, indices
+      real(c_double), dimension(*), intent(in) :: values, b
+      real(c_double), dimension(*), intent(out) :: x, berr
+      character(kind=c_char), dimension(*), intent(in) :: options
+    end function slu_tpu_solve
+
+    ! Persistent factorization handle (LUstruct/SOLVEstruct pattern;
+    ! the Fact reuse ladder from Fortran).  Returns handle > 0 or -1.
+    integer(c_int64_t) function slu_tpu_factorize(n, nnz, indptr, &
+        indices, values, options) bind(c, name="slu_tpu_factorize")
+      import :: c_int64_t, c_double, c_char
+      integer(c_int64_t), value :: n, nnz
+      integer(c_int64_t), dimension(*), intent(in) :: indptr, indices
+      real(c_double), dimension(*), intent(in) :: values
+      character(kind=c_char), dimension(*), intent(in) :: options
+    end function slu_tpu_factorize
+
+    ! Solve against a held factorization; trans /= 0 solves A^T x = b.
+    integer(c_int64_t) function slu_tpu_solve_factored(handle, nrhs, &
+        b, x, trans) bind(c, name="slu_tpu_solve_factored")
+      import :: c_int64_t, c_double
+      integer(c_int64_t), value :: handle, nrhs, trans
+      real(c_double), dimension(*), intent(in) :: b
+      real(c_double), dimension(*), intent(out) :: x
+    end function slu_tpu_solve_factored
+
+    integer(c_int64_t) function slu_tpu_free(handle) &
+        bind(c, name="slu_tpu_free")
+      import :: c_int64_t
+      integer(c_int64_t), value :: handle
+    end function slu_tpu_free
+
+    ! Last error message (C string, valid until the next failing call).
+    type(c_ptr) function slu_tpu_last_error() &
+        bind(c, name="slu_tpu_last_error")
+      import :: c_ptr
+    end function slu_tpu_last_error
+
+  end interface
+
+end module slu_tpu_mod

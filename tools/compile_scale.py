@@ -56,15 +56,23 @@ def main():
         x = np.asarray(x)
         return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
+    def aval(x):
+        """(shape, dtype) — what actually keys the jit executable
+        cache; dtype matters because dev() picks int32 vs int64 per
+        group by span."""
+        x = np.asarray(x)
+        return (x.shape, str(x.dtype))
+
     fsigs, ssigs = {}, {}
     for g in sched.groups:
         a_src, a_dst, one_dst, ea_blocks, ci, si = g.dev(squeeze=True)
-        ea_shapes = tuple(jax.tree_util.tree_leaves(
-            jax.tree_util.tree_map(lambda x: np.shape(x), ea_blocks)))
-        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, a_src.shape,
-                a_dst.shape, one_dst.shape, ea_shapes)
+        ea_avals = tuple(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(aval, ea_blocks,
+                                   is_leaf=lambda x: hasattr(x, "dtype"))))
+        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, aval(a_src),
+                aval(a_dst), aval(one_dst), ea_avals)
         fsigs.setdefault(fkey, g)
-        skey = (g.mb, g.wb, g.n_loc, ci.shape, si.shape)
+        skey = (g.mb, g.wb, g.n_loc, aval(ci), aval(si))
         ssigs.setdefault(skey, g)
 
     t0 = time.perf_counter()
@@ -79,15 +87,16 @@ def main():
             jax.ShapeDtypeStruct((), np.int64),
             mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta).compile()
     nrhs = 1
-    for (mb, wb, n_pad, ci_s, si_s), g in ssigs.items():
-        B._staged_sweep_group.lower(
-            jax.ShapeDtypeStruct((sched.n + 1, nrhs), dtype),
-            jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
-            jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
-            jax.ShapeDtypeStruct(ci_s, np.int32),
-            jax.ShapeDtypeStruct(si_s, np.int32),
-            mb=mb, wb=wb, n_pad=n_pad, cplx=False,
-            kind="fwd").compile()
+    for (mb, wb, n_pad, ci_a, si_a), g in ssigs.items():
+        for kind in ("fwd", "bwd"):   # each kind is its own executable
+            B._staged_sweep_group.lower(
+                jax.ShapeDtypeStruct((sched.n + 1, nrhs), dtype),
+                jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
+                jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
+                jax.ShapeDtypeStruct(ci_a[0], np.dtype(ci_a[1])),
+                jax.ShapeDtypeStruct(si_a[0], np.dtype(si_a[1])),
+                mb=mb, wb=wb, n_pad=n_pad, cplx=False,
+                kind=kind).compile()
     t_compile = time.perf_counter() - t0
 
     print(json.dumps({
