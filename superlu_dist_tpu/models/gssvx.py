@@ -364,4 +364,41 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
                        user_perm_r=user_perm_r, user_perm_c=user_perm_c,
                        grid=grid)
     x = solve(lu, b, stats=stats)
+    if _should_escalate(options, lu, stats):
+        # the low-precision factor failed its refinement contract
+        # (cond(A)·eps_factor ≥ 1: berr stagnated far above the
+        # refine-precision class).  Refactor ONCE at refine precision
+        # — the safety net the psgssvx_d2 strategy (SURVEY.md §2.6,
+        # psgssvx_d2.c:516) leaves to the caller, automatic here
+        # because GESP has no mid-factor pivoting to fall back on.
+        # The plan is value-identical, so it is reused outright.
+        stats.escalations += 1
+        opts2 = options.replace(factor_dtype=options.refine_dtype)
+        lu = factorize(a, opts2, plan=lu.plan, stats=stats,
+                       backend=backend, grid=grid)
+        x = solve(lu, b, stats=stats)
     return x, lu, stats
+
+
+def _should_escalate(options: Options, lu: LUFactorization,
+                     stats: Stats) -> bool:
+    if not options.escalate:
+        return False
+    if options.iter_refine == IterRefine.NOREFINE:
+        return False
+    if options.fact == Fact.FACTORED:
+        # solve-only rung: never silently re-pay a factorization on a
+        # reused handle (and the escalated handle would be discarded
+        # by a caller looping over their original lu anyway)
+        return False
+    import jax.numpy as jnp   # jnp.finfo understands bfloat16
+    # the dtype of the factors actually used, not the caller's field
+    # (they differ on reuse rungs)
+    f_eps = float(jnp.finfo(jnp.dtype(
+        lu.effective_options.factor_dtype)).eps)
+    r_eps = float(jnp.finfo(jnp.dtype(options.refine_dtype)).eps)
+    if f_eps <= r_eps:            # nothing higher to escalate to
+        return False
+    # NaN/Inf berr (overflowed low-precision factor) must escalate —
+    # write the test as "not converged" so non-finite falls through
+    return not (stats.berr <= float(np.sqrt(r_eps)))

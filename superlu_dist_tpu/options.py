@@ -142,6 +142,15 @@ class Options:
 
     # --- iterative refinement controls ---
     max_refine_steps: int = 8
+    # Precision escalation: when a low-precision factor's refinement
+    # stagnates above sqrt(eps(refine_dtype)) — the cond·eps_factor
+    # contract failed — gssvx refactors once at refine_dtype and
+    # resolves.  The safety net the psgssvx_d2 strategy leaves to the
+    # caller (SURVEY.md §2.6); here it is automatic because GESP has
+    # no numerical pivoting to fall back on mid-factor.
+    escalate: YesNo = dataclasses.field(
+        default_factory=lambda: YesNo(
+            1 if _env_int("SUPERLU_ESCALATE", 1) else 0))
 
     # --- TPU bucketing (replaces ragged supernode shapes; SURVEY.md §7) ---
     width_buckets: tuple = (8, 16, 32, 64, 128, 256, 512)

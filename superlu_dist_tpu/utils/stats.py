@@ -79,6 +79,9 @@ class Stats:
     tiny_pivots: int = 0
     refine_steps: int = 0
     berr: float = 0.0
+    # precision escalations: low-precision factor failed refinement,
+    # refactored at refine_dtype (gssvx _should_escalate)
+    escalations: int = 0
     # memory accounting (dQuerySpace_dist analog, SRC/superlu_ddefs.h:616)
     lu_nnz: int = 0
     lu_bytes: int = 0
@@ -120,6 +123,9 @@ class Stats:
             lines.append(line)
         lines.append(f"  tiny pivots replaced: {self.tiny_pivots}")
         lines.append(f"  refinement steps:     {self.refine_steps}")
+        if self.escalations:
+            lines.append(
+                f"  precision escalations: {self.escalations}")
         if self.lu_nnz:
             lines.append(
                 f"  nnz(L+U): {self.lu_nnz}  LU bytes: {self.lu_bytes}")

@@ -1,0 +1,100 @@
+"""Precision escalation (gssvx _should_escalate): when a low-precision
+factor's iterative refinement stagnates above sqrt(eps(refine_dtype)),
+gssvx refactors once at refine precision — the safety net the
+psgssvx_d2 mixed-precision strategy (SRC/psgssvx_d2.c:516) leaves to
+the caller, automatic here because GESP has no mid-factor pivoting to
+fall back on."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu import Options, YesNo, gssvx
+from superlu_dist_tpu.sparse import csr_from_scipy
+
+
+def _illcond(n=40, spread=10, seed=0):
+    """Dense-as-sparse matrix with cond = 10^spread via SVD synthesis:
+    equilibration cannot fix SVD conditioning, so cond·eps_f32 >> 1
+    (refinement with an f32 factor diverges) while cond·eps_f64 < 1
+    (an f64 factor refines to f64 class)."""
+    rng = np.random.default_rng(seed)
+    u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -spread, n)
+    return csr_from_scipy(sp.csr_matrix(u @ np.diag(s) @ v.T))
+
+
+@pytest.mark.parametrize("backend", ["jax", "host"])
+def test_escalates_to_f64_and_recovers(backend):
+    a = _illcond()
+    rng = np.random.default_rng(1)
+    xtrue = rng.standard_normal(a.n)
+    b = a.to_scipy() @ xtrue
+    x, lu, stats = gssvx(Options(factor_dtype="float32"), a, b,
+                         backend=backend)
+    assert stats.escalations == 1
+    # escalated factors are f64: berr meets the refine-precision
+    # contract (below the sqrt(eps_f64) trigger — the device path's
+    # inverse-based solves stall IR above the host path's 1e-13
+    # class on this conditioning, the documented cond(U11) term,
+    # DESIGN.md §6)
+    assert stats.berr < np.sqrt(np.finfo(np.float64).eps)
+    # the handle returned is the escalated one (reusable at f64)
+    assert lu.effective_options.factor_dtype == "float64"
+    assert "precision escalations" in stats.report()
+
+
+def test_escalation_can_be_disabled():
+    a = _illcond()
+    rng = np.random.default_rng(2)
+    b = a.to_scipy() @ rng.standard_normal(a.n)
+    x, lu, stats = gssvx(Options(factor_dtype="float32",
+                                 escalate=YesNo.NO), a, b)
+    assert stats.escalations == 0
+    # without the net, the f32 factor's refinement stagnates far
+    # above the f64 class — exactly the failure the default catches
+    assert stats.berr > 1e-8
+
+
+def test_no_escalation_when_contract_holds():
+    """A well-conditioned system at f32+IR must not pay a second
+    factorization."""
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(20, 20))
+    a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+    rng = np.random.default_rng(3)
+    xtrue = rng.standard_normal(a.n)
+    x, lu, stats = gssvx(Options(factor_dtype="float32"), a,
+                         a.to_scipy() @ xtrue)
+    assert stats.escalations == 0
+    assert lu.effective_options.factor_dtype == "float32"
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-10
+
+
+def test_f64_factor_never_escalates():
+    """factor_dtype == refine_dtype has nothing to escalate to, even
+    on a hopeless matrix."""
+    a = _illcond(spread=15)
+    rng = np.random.default_rng(4)
+    b = a.to_scipy() @ rng.standard_normal(a.n)
+    x, lu, stats = gssvx(Options(), a, b)
+    assert stats.escalations == 0
+
+
+def test_factored_rung_never_escalates():
+    """FACTORED is the solve-only rung: a reused low-precision handle
+    must not silently re-pay a factorization per solve, even when its
+    refinement stagnates (the returned escalated handle would be
+    discarded by a caller looping over their original lu)."""
+    from superlu_dist_tpu import Fact
+    a = _illcond()
+    rng = np.random.default_rng(5)
+    b = a.to_scipy() @ rng.standard_normal(a.n)
+    x, lu, stats = gssvx(Options(factor_dtype="float32",
+                                 escalate=YesNo.NO), a, b)
+    assert lu.effective_options.factor_dtype == "float32"
+    x2, lu2, st2 = gssvx(Options(factor_dtype="float32",
+                                 fact=Fact.FACTORED), a, b, lu=lu)
+    assert st2.escalations == 0
+    assert lu2.effective_options.factor_dtype == "float32"
