@@ -98,3 +98,19 @@ def test_factored_rung_never_escalates():
                                  fact=Fact.FACTORED), a, b, lu=lu)
     assert st2.escalations == 0
     assert lu2.effective_options.factor_dtype == "float32"
+
+
+def test_escalation_on_mesh_backend():
+    """The escalation hook is backend-agnostic: a mesh-sharded f32
+    factorization that stagnates refactors at f64 over the SAME mesh."""
+    from superlu_dist_tpu.parallel.grid import make_solver_mesh
+    a = _illcond()
+    rng = np.random.default_rng(6)
+    b = a.to_scipy() @ rng.standard_normal(a.n)
+    g = make_solver_mesh(2, 2, 2)
+    x, lu, stats = gssvx(Options(factor_dtype="float32"), a, b,
+                         grid=g)
+    assert stats.escalations == 1
+    assert stats.berr < np.sqrt(np.finfo(np.float64).eps)
+    assert lu.backend == "dist"
+    assert lu.effective_options.factor_dtype == "float64"
