@@ -163,7 +163,15 @@ def main():
     # the solver, not the tunnel; the fused program is one dispatch
     # and its compile is one-time + persistently cached.
     os.environ.setdefault("SLU_STAGED", "0")
-    cpu_fallback, fb_reason = _ensure_live_backend()
+    if os.environ.get("SLU_BENCH_CHILD") == "1":
+        # re-exec'd after the accelerator died mid-run (see below):
+        # this IS the CPU fallback, regardless of what the probe says;
+        # the original failure rides along in the env
+        cpu_fallback = True
+        fb_reason = os.environ.get("SLU_BENCH_FAIL_REASON",
+                                   "runtime-failure")
+    else:
+        cpu_fallback, fb_reason = _ensure_live_backend()
 
     # CPU execution: cap codegen at AVX2 so compiled artifacts stay
     # valid if the VM live-migrates across CPU models mid-run (model-
@@ -222,7 +230,24 @@ def main():
         desc = f"2D Laplacian n={k * k}"
     nrhs = int(os.environ.get("SLU_BENCH_NRHS", "1"))
 
-    r = _run_config(a, desc, nrhs, jnp)
+    try:
+        r = _run_config(a, desc, nrhs, jnp)
+    except Exception as e:
+        # the probe passed but the device died mid-run (tunnel drop,
+        # unsupported op, OOM).  The contract line must still print:
+        # re-exec this script pinned to CPU — a fresh process, because
+        # the wedged backend is already initialized in this one.  A
+        # run that was ALREADY on CPU fails deterministically; re-
+        # running it would only repeat the failure, so raise loudly.
+        if not on_accel:
+            raise
+        print(f"bench: accelerator run failed ({e!r}); "
+              "re-exec on CPU", file=sys.stderr)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLU_BENCH_CHILD="1",
+                   SLU_BENCH_FAIL_REASON=f"runtime:{type(e).__name__}")
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
 
     mfu_txt = ""
     if peak_tf > 0:
