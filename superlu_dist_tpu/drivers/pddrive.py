@@ -158,16 +158,34 @@ def _solve_fused(a, b, opts, stats):
     if opts.trans != Trans.NOTRANS:
         raise SystemExit("fused solver is NOTRANS-only; drop --fused "
                          "for transpose solves")
+    from ..models.gssvx import (_should_escalate_fused,
+                                effective_factor_dtype)
+
     plan = plan_factorization(a, opts, stats=stats)
-    step = make_fused_solver(plan, dtype=opts.factor_dtype)
-    with stats.timer("FACT"):
-        x, berr, steps, tiny, _ = step(jnp.asarray(a.data),
-                                       jnp.asarray(b))
-        x.block_until_ready()
-    stats.add_ops("FACT", plan.factor_flops)
-    stats.berr = float(berr)
-    stats.refine_steps = int(steps)
-    stats.tiny_pivots = int(tiny)
+
+    def run(dtype_name):
+        # one fused build+run with uniform accounting (the escalated
+        # rerun must count its flops/pivots exactly like the first)
+        fdt = effective_factor_dtype(a.dtype, dtype_name)
+        step = make_fused_solver(plan, dtype=fdt)
+        with stats.timer("FACT"):
+            x, berr, steps, tiny, _ = step(jnp.asarray(a.data),
+                                           jnp.asarray(b))
+            x.block_until_ready()
+        stats.add_ops("FACT", plan.factor_flops)
+        stats.berr = float(berr)
+        stats.refine_steps += int(steps)
+        stats.tiny_pivots += int(tiny)
+        return x
+
+    x = run(opts.factor_dtype)
+    if _should_escalate_fused(opts, stats):
+        # same safety net as gssvx (models/gssvx._should_escalate):
+        # the low-precision factor failed its refinement contract —
+        # rebuild the whole fused program at refine precision on the
+        # SAME plan and rerun
+        stats.escalations += 1
+        x = run(opts.refine_dtype)
     return np.asarray(x)
 
 

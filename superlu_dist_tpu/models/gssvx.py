@@ -382,20 +382,31 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
 
 def _should_escalate(options: Options, lu: LUFactorization,
                      stats: Stats) -> bool:
-    if not options.escalate:
-        return False
-    if options.iter_refine == IterRefine.NOREFINE:
-        return False
     if options.fact == Fact.FACTORED:
         # solve-only rung: never silently re-pay a factorization on a
         # reused handle (and the escalated handle would be discarded
         # by a caller looping over their original lu anyway)
         return False
-    import jax.numpy as jnp   # jnp.finfo understands bfloat16
     # the dtype of the factors actually used, not the caller's field
     # (they differ on reuse rungs)
-    f_eps = float(jnp.finfo(jnp.dtype(
-        lu.effective_options.factor_dtype)).eps)
+    return _escalation_core(options,
+                            lu.effective_options.factor_dtype, stats)
+
+
+def _should_escalate_fused(options: Options, stats: Stats) -> bool:
+    """Escalation test for the fused one-program path (pddrive
+    --fused), which always factors fresh at options.factor_dtype."""
+    return _escalation_core(options, options.factor_dtype, stats)
+
+
+def _escalation_core(options: Options, factor_dtype: str,
+                     stats: Stats) -> bool:
+    if not options.escalate:
+        return False
+    if options.iter_refine == IterRefine.NOREFINE:
+        return False
+    import jax.numpy as jnp   # jnp.finfo understands bfloat16
+    f_eps = float(jnp.finfo(jnp.dtype(factor_dtype)).eps)
     r_eps = float(jnp.finfo(jnp.dtype(options.refine_dtype)).eps)
     if f_eps <= r_eps:            # nothing higher to escalate to
         return False

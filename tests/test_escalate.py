@@ -114,3 +114,19 @@ def test_escalation_on_mesh_backend():
     assert stats.berr < np.sqrt(np.finfo(np.float64).eps)
     assert lu.backend == "dist"
     assert lu.effective_options.factor_dtype == "float64"
+
+
+def test_fused_driver_path_escalates():
+    """pddrive --fused embeds refinement on-device; its berr feeds the
+    same escalation net (rebuild the fused program at refine
+    precision on the same plan)."""
+    from superlu_dist_tpu.drivers.pddrive import _solve_fused
+    from superlu_dist_tpu.utils.stats import Stats
+    a = _illcond()
+    rng = np.random.default_rng(7)
+    xtrue = rng.standard_normal((a.n, 1))
+    b = a.to_scipy() @ xtrue
+    stats = Stats()
+    x = _solve_fused(a, b, Options(factor_dtype="float32"), stats)
+    assert stats.escalations == 1
+    assert stats.berr < np.sqrt(np.finfo(np.float64).eps)
