@@ -848,14 +848,51 @@ def _mm_enc(sub: str, A, xe, cplx: bool):
     halves concatenated along the last axis); returns the encoded
     product.  Real A (real factor, complex rhs) contracts both halves
     in one einsum; complex A splits into real/imag contractions:
-    (Ar + i·Ai)(xr + i·xi) = (Ar·xr − Ai·xi) + i·(Ar·xi + Ai·xr)."""
-    if not cplx or not jnp.issubdtype(A.dtype, jnp.complexfloating):
+    (Ar + i·Ai)(xr + i·xi) = (Ar·xr − Ai·xi) + i·(Ar·xi + Ai·xr).
+    A may also arrive pre-split as an (Ar, Ai) pair (the all-real
+    solve storage, _solve_view) — then the program contains no
+    complex extraction at all."""
+    if isinstance(A, tuple):
+        Ar, Ai = A
+    elif not cplx or not jnp.issubdtype(A.dtype, jnp.complexfloating):
         return jnp.einsum(sub, A, xe)
+    else:
+        Ar, Ai = A.real, A.imag
     h = xe.shape[-1] // 2
-    er = jnp.einsum(sub, A.real, xe)
-    ei = jnp.einsum(sub, A.imag, xe)
+    er = jnp.einsum(sub, Ar, xe)
+    ei = jnp.einsum(sub, Ai, xe)
     return jnp.concatenate([er[..., :h] - ei[..., h:],
                             er[..., h:] + ei[..., :h]], axis=-1)
+
+
+def _solve_view(flat):
+    """Solve-storage view of a factor flat: a complex flat becomes a
+    (2, N) stacked real/imag REAL array.  Used by the distributed
+    solve loop so its compiled program contains no complex ops at all
+    — complex dynamic-slice/real-extraction were the last complex
+    family left in that program, and XLA:CPU's threaded runtime has
+    produced rare nondeterministic NaN there (the
+    test_complex_dist_solve_deterministic canary)."""
+    if jnp.issubdtype(flat.dtype, jnp.complexfloating):
+        return jnp.stack([flat.real, flat.imag])
+    return flat
+
+
+def _slice_panel(flat, off, size: int, shape: tuple):
+    """dynamic_slice + reshape of one group's panel from a factor
+    flat, handling both storages: a 1-D flat yields the panel array; a
+    (2, N) stacked real/imag flat yields an (Ar, Ai) pair for
+    _mm_enc."""
+    if flat.ndim == 2:
+        P = jax.lax.dynamic_slice(
+            flat, (jnp.int32(0), off), (2, size)).reshape((2,) + shape)
+        return (P[0], P[1])
+    return jax.lax.dynamic_slice(flat, (off,), (size,)).reshape(shape)
+
+
+def _psub(P, fn):
+    """Apply a slicing fn to a panel in either storage form."""
+    return tuple(fn(p) for p in P) if isinstance(P, tuple) else fn(P)
 
 
 def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
@@ -865,15 +902,16 @@ def _fwd_group_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
     this on its own X copy (dummy indices elsewhere) and _solve_loop
     reconciles by psum-of-diffs at its static sync points."""
     xb = X[col_idx]                                     # (Np, wb, R̂)
-    Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
-                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    Li = _slice_panel(Li_flat, Li_off, n_pad * wb * wb,
+                      (n_pad, wb, wb))
     y = _mm_enc("nvw,nwr->nvr", Li, xb, cplx)           # Li @ xb
     X = X.at[col_idx].set(y)
     if mb > wb:
-        Lp = jax.lax.dynamic_slice(
-            L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
+        Lp = _slice_panel(L_flat, L_off, n_pad * mb * wb,
+                          (n_pad, mb, wb))
         X = X.at[struct_idx].add(
-            -_mm_enc("nsw,nwr->nsr", Lp[:, wb:, :], y, cplx))
+            -_mm_enc("nsw,nwr->nsr",
+                     _psub(Lp, lambda p: p[:, wb:, :]), y, cplx))
     return X
 
 
@@ -884,14 +922,15 @@ def _bwd_group_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
                     cplx: bool = False):
     xb = X[col_idx]
     if mb > wb:
-        Up = jax.lax.dynamic_slice(
-            U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
+        Up = _slice_panel(U_flat, U_off, n_pad * wb * mb,
+                          (n_pad, wb, mb))
         xs = X[struct_idx]
-        rhs = xb - _mm_enc("nws,nsr->nwr", Up[:, :, wb:], xs, cplx)
+        rhs = xb - _mm_enc("nws,nsr->nwr",
+                           _psub(Up, lambda p: p[:, :, wb:]), xs, cplx)
     else:
         rhs = xb
-    Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
-                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    Ui = _slice_panel(Ui_flat, Ui_off, n_pad * wb * wb,
+                      (n_pad, wb, wb))
     x1 = _mm_enc("nvw,nwr->nvr", Ui, rhs, cplx)
     return X.at[col_idx].set(x1)
 
@@ -906,15 +945,16 @@ def _fwd_group_T_impl(X, U_flat, Ui_flat, col_idx, struct_idx, U_off,
                       Ui_off, *, mb: int, wb: int, n_pad: int,
                       cplx: bool = False):
     xb = X[col_idx]
-    Ui = jax.lax.dynamic_slice(Ui_flat, (Ui_off,),
-                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    Ui = _slice_panel(Ui_flat, Ui_off, n_pad * wb * wb,
+                      (n_pad, wb, wb))
     y = _mm_enc("nwv,nwr->nvr", Ui, xb, cplx)       # Uiᵀ @ xb
     X = X.at[col_idx].set(y)
     if mb > wb:
-        Up = jax.lax.dynamic_slice(
-            U_flat, (U_off,), (n_pad * wb * mb,)).reshape(n_pad, wb, mb)
+        Up = _slice_panel(U_flat, U_off, n_pad * wb * mb,
+                          (n_pad, wb, mb))
         X = X.at[struct_idx].add(
-            -_mm_enc("nws,nwr->nsr", Up[:, :, wb:], y, cplx))
+            -_mm_enc("nws,nwr->nsr",
+                     _psub(Up, lambda p: p[:, :, wb:]), y, cplx))
     return X
 
 
@@ -925,14 +965,15 @@ def _bwd_group_T_impl(X, L_flat, Li_flat, col_idx, struct_idx, L_off,
                       cplx: bool = False):
     xb = X[col_idx]
     if mb > wb:
-        Lp = jax.lax.dynamic_slice(
-            L_flat, (L_off,), (n_pad * mb * wb,)).reshape(n_pad, mb, wb)
+        Lp = _slice_panel(L_flat, L_off, n_pad * mb * wb,
+                          (n_pad, mb, wb))
         xs = X[struct_idx]
-        rhs = xb - _mm_enc("nsw,nsr->nwr", Lp[:, wb:, :], xs, cplx)
+        rhs = xb - _mm_enc("nsw,nsr->nwr",
+                           _psub(Lp, lambda p: p[:, wb:, :]), xs, cplx)
     else:
         rhs = xb
-    Li = jax.lax.dynamic_slice(Li_flat, (Li_off,),
-                               (n_pad * wb * wb,)).reshape(n_pad, wb, wb)
+    Li = _slice_panel(Li_flat, Li_off, n_pad * wb * wb,
+                      (n_pad, wb, wb))
     x1 = _mm_enc("nwv,nwr->nvr", Li, rhs, cplx)     # Liᵀ @ rhs
     return X.at[col_idx].set(x1)
 

@@ -51,7 +51,7 @@ from ..plan.plan import FactorPlan
 from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl, _dec,
                            _enc, _factor_group_impl, _fwd_group_impl,
                            _fwd_group_T_impl, _hi_prec, _real_dtype,
-                           _thresh_for, get_schedule)
+                           _solve_view, _thresh_for, get_schedule)
 
 
 def _resolve_axis(mesh: Mesh, axis):
@@ -114,7 +114,18 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
     zone-affine subtree interiors sweep with zero collectives, the
     pdgstrs C_Tree forest (SRC/pdgstrs.c:2133) collapsed to one
     reduction per zone boundary."""
-    L_flat, U_flat, Li_flat, Ui_flat = flats
+    # complex factors sweep on stacked real/imag planes
+    # (batched._solve_view): the SWEEP BODY — per-group panel
+    # dynamic-slice, extraction, einsum — becomes complex-free; the
+    # one-time whole-array real/imag extraction remains in the
+    # program prologue.  Complex per-panel slicing is where XLA:CPU's
+    # threaded runtime raced (rare nondeterministic NaN, caught by
+    # tests/test_coop.py::test_complex_dist_solve_deterministic).
+    # Follow-up if the prologue ever misbehaves or the O(nnz) restack
+    # per solve shows up in profiles: materialize this storage once
+    # at factor time in DistLU.
+    L_flat, U_flat, Li_flat, Ui_flat = (
+        _solve_view(f) for f in flats)
     n = dsched.n
     xdt = jnp.promote_types(dtype, b.dtype)
     cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))

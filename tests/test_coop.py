@@ -111,18 +111,44 @@ def test_coop_gssvx_and_diag_u(force_coop):
                                rtol=1e-10)
 
 
-def test_coop_complex(force_coop):
-    a, A, xtrue, b = _problem(24, complex_=True)
-    plan = plan_factorization(a, Options())
-    vals = plan.scaled_values(a.data)
-    bf = b[plan.final_row]
-    g = make_solver_mesh(2, 2, 2)
-    step, _ = make_dist_step(plan, g.mesh, dtype=np.complex128)
-    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
-    lu1 = factorize_device(plan, vals, dtype=np.complex128)
-    x1 = solve_device(lu1, bf)
-    assert np.allclose(x, x1, atol=1e-10), \
-        f"max diff {np.abs(x - x1).max():.3e}"
+# shared subprocess setup for the complex-dist lottery-contained
+# tests: the SAME problem _problem(24, complex_=True) builds, as a
+# script prelude (one copy — the two test bodies must not drift)
+_COMPLEX_SETUP = r"""
+from superlu_dist_tpu import Options, csr_from_scipy
+from superlu_dist_tpu.ops.batched import factorize_device, solve_device
+from superlu_dist_tpu.parallel.factor_dist import (make_dist_factor,
+                                                   make_dist_solve,
+                                                   make_dist_step)
+from superlu_dist_tpu.parallel.grid import make_solver_mesh
+from superlu_dist_tpu.plan.plan import plan_factorization
+t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(24, 24))
+A = sp.kronsum(t, t, format="csr")
+A = (A + 1j * sp.diags(np.linspace(0.1, 0.4, A.shape[0]))).tocsr()
+a = csr_from_scipy(A)
+rng = np.random.default_rng(0)
+xtrue = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
+b = A @ xtrue
+plan = plan_factorization(a, Options())
+vals = plan.scaled_values(a.data)
+bf = b[plan.final_row]
+g = make_solver_mesh(2, 2, 2)
+"""
+
+
+def test_coop_complex():
+    """Coop complex factor+solve over a 3D mesh matches the
+    single-device path.  Complex + multi-device client => compile-
+    lottery containment (lottery_util docstring)."""
+    from lottery_util import run_double_draw
+    run_double_draw(_COMPLEX_SETUP + r"""
+step, _ = make_dist_step(plan, g.mesh, dtype=np.complex128)
+x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+lu1 = factorize_device(plan, np.asarray(vals), dtype=np.complex128)
+x1 = solve_device(lu1, bf)
+assert np.allclose(x, x1, atol=1e-10), \
+    f"max diff {np.abs(x - x1).max():.3e}"
+""", env_extra={"SLU_COOP_MB": "32"})
 
 
 def test_coop_uneven_column_slices(force_coop):
@@ -161,29 +187,39 @@ def test_coop_mesh_shape_invariance(force_coop):
             assert np.allclose(x, ref, atol=1e-10)
 
 
-def test_complex_dist_solve_deterministic(force_coop):
-    """Run-to-run determinism of the complex dist solve (regression:
-    complex all-reduce on the XLA:CPU threaded runtime intermittently
-    produced wrong values/NaN; psum_exact splits real/imag planes)."""
-    from superlu_dist_tpu.parallel.factor_dist import (make_dist_factor,
-                                                       make_dist_solve)
+_CANARY = _COMPLEX_SETUP + r"""
+bf = jnp.asarray(bf)
+dlu = make_dist_factor(plan, g.mesh,
+                       dtype=np.complex128)(jnp.asarray(vals))
+solve = make_dist_solve(plan, g.mesh, dtype=np.complex128)
+lu1 = factorize_device(plan, np.asarray(vals), dtype=np.complex128)
+x1 = solve_device(lu1, np.asarray(bf))
+x0 = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                      dlu.Ui_flat, bf))
+assert np.allclose(x0, x1, atol=1e-10), \
+    f"dist vs single max diff {np.abs(x0 - x1).max():.3e}"
+for _ in range(10):
+    x = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                         dlu.Ui_flat, bf))
+    assert np.array_equal(x, x0), \
+        f"nondeterministic solve: {np.abs(x - x0).max():.3e}"
+"""
 
-    a, A, xtrue, b = _problem(24, complex_=True)
-    plan = plan_factorization(a, Options())
-    vals = plan.scaled_values(a.data)
-    bf = jnp.asarray(b[plan.final_row])
-    g = make_solver_mesh(2, 2, 2)
-    dlu = make_dist_factor(plan, g.mesh,
-                           dtype=np.complex128)(jnp.asarray(vals))
-    solve = make_dist_solve(plan, g.mesh, dtype=np.complex128)
-    lu1 = factorize_device(plan, vals, dtype=np.complex128)
-    x1 = solve_device(lu1, np.asarray(bf))
-    x0 = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
-                          dlu.Ui_flat, bf))
-    assert np.allclose(x0, x1, atol=1e-10), \
-        f"max diff {np.abs(x0 - x1).max():.3e}"
-    for _ in range(10):
-        x = np.asarray(solve(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
-                             dlu.Ui_flat, bf))
-        assert np.array_equal(x, x0), \
-            f"nondeterministic solve: {np.abs(x - x0).max():.3e}"
+
+def test_complex_dist_solve_deterministic():
+    """Determinism + dist/single agreement of the complex dist solve.
+
+    Regression coverage for two environmental bug families of the
+    forced-multi-device XLA:CPU client: the threaded runtime's
+    intermittent wrong-values/NaN on complex collectives (answered by
+    psum_exact real/imag splitting), and rare nondeterministic NaN in
+    complex panel slicing during sweeps (answered by the all-real
+    solve storage, batched._solve_view).  The remaining complex
+    programs (the FACTOR path) still play the per-process compile
+    lottery — hence the double-draw harness (lottery_util).  A
+    NONDETERMINISM failure (same executable, different bytes) is
+    fatal on the first draw: the lottery is a per-compile draw and
+    cannot explain within-process divergence."""
+    from lottery_util import run_double_draw
+    run_double_draw(_CANARY, env_extra={"SLU_COOP_MB": "32"},
+                    fatal_patterns=("nondeterministic solve",))

@@ -42,23 +42,32 @@ def test_dist_matches_truth_and_mesh_invariance(ndev):
 
 
 def test_dist_complex():
-    """Complex (z-precision) system over a mesh — pzdrive3d parity."""
-    a_r = convection_diffusion_2d(8)
-    rng = np.random.default_rng(7)
-    from superlu_dist_tpu.sparse import CSRMatrix
-    data = a_r.data + 1j * rng.standard_normal(len(a_r.data)) * 0.1
-    a = CSRMatrix(a_r.m, a_r.n, a_r.indptr, a_r.indices, data)
-    plan = plan_factorization(a, Options(factor_dtype="complex128"))
-    xtrue = (rng.standard_normal(a.n)
-             + 1j * rng.standard_normal(a.n))
-    b = a.to_scipy() @ xtrue
-    mesh = _mesh_1d(4)
-    step, _ = make_dist_step(plan, mesh, dtype=np.complex128)
-    bf = np.empty_like(b)
-    bf[plan.final_row] = b * plan.row_scale
-    x = np.asarray(step(plan.scaled_values(a), bf[:, None]))
-    xs = x[plan.final_col][:, 0] * plan.col_scale
-    np.testing.assert_allclose(xs, xtrue, rtol=1e-8, atol=1e-8)
+    """Complex (z-precision) system over a mesh — pzdrive3d parity.
+    Complex + multi-device client => compile-lottery containment
+    (lottery_util docstring)."""
+    from lottery_util import run_double_draw
+    run_double_draw(r"""
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.parallel.factor_dist import make_dist_step
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.sparse import CSRMatrix
+from superlu_dist_tpu.utils.testmat import convection_diffusion_2d
+from jax.sharding import Mesh
+a_r = convection_diffusion_2d(8)
+rng = np.random.default_rng(7)
+data = a_r.data + 1j * rng.standard_normal(len(a_r.data)) * 0.1
+a = CSRMatrix(a_r.m, a_r.n, a_r.indptr, a_r.indices, data)
+plan = plan_factorization(a, Options(factor_dtype="complex128"))
+xtrue = rng.standard_normal(a.n) + 1j * rng.standard_normal(a.n)
+b = a.to_scipy() @ xtrue
+mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("d",))
+step, _ = make_dist_step(plan, mesh, dtype=np.complex128)
+bf = np.empty_like(b)
+bf[plan.final_row] = b * plan.row_scale
+x = np.asarray(step(plan.scaled_values(a), bf[:, None]))
+xs = x[plan.final_col][:, 0] * plan.col_scale
+np.testing.assert_allclose(xs, xtrue, rtol=1e-8, atol=1e-8)
+""")
 
 
 def test_dist_unsymmetric():
