@@ -87,3 +87,34 @@ def test_fuzz_consistency(case):
     else:
         tol = max(100 * ref, 1e-10)
     assert got < tol, (case, got, ref)
+
+
+@pytest.mark.parametrize("case", [0, 3, 7, 11])
+def test_fuzz_reuse_ladder(case):
+    """The Fact reuse rungs on random structures: factor once, perturb
+    values on the same pattern, walk SAME_PATTERN and
+    SAME_PATTERN_SAME_ROWPERM, then FACTORED re-solves with a new
+    right-hand side — the production flow the ladder exists for."""
+    from superlu_dist_tpu import Fact
+    rng = np.random.default_rng(7000 + case)
+    n = int(rng.integers(25, 90))
+    A = _random_system(rng, n, density=float(rng.uniform(0.03, 0.1)),
+                       scale_spread=1.5, complex_=(case == 7))
+    a = csr_from_scipy(A)
+    dt = complex if case == 7 else float
+    xt = rng.standard_normal(n).astype(dt)
+    x, lu, _ = gssvx(Options(), a, A @ xt)
+    assert np.linalg.norm(x - xt) / np.linalg.norm(xt) < 1e-10
+
+    # same pattern, perturbed values (keep the diagonal dominant)
+    A2 = A.copy()
+    A2.data = A.data * (1.0 + 0.05 * rng.standard_normal(len(A.data)))
+    a2 = csr_from_scipy(A2)
+    for fact in (Fact.SAME_PATTERN, Fact.SAME_PATTERN_SAME_ROWPERM):
+        x2, lu2, _ = gssvx(Options(fact=fact), a2, A2 @ xt, lu=lu)
+        err = np.linalg.norm(x2 - xt) / np.linalg.norm(xt)
+        assert err < 1e-10, (case, fact, err)
+    # solve-only rung on the refreshed handle, new rhs
+    xt3 = rng.standard_normal(n).astype(dt)
+    x3, _, _ = gssvx(Options(fact=Fact.FACTORED), a2, A2 @ xt3, lu=lu2)
+    assert np.linalg.norm(x3 - xt3) / np.linalg.norm(xt3) < 1e-10
