@@ -60,7 +60,7 @@ a = CSRMatrix(a_r.m, a_r.n, a_r.indptr, a_r.indices, data)
 plan = plan_factorization(a, Options(factor_dtype="complex128"))
 xtrue = rng.standard_normal(a.n) + 1j * rng.standard_normal(a.n)
 b = a.to_scipy() @ xtrue
-mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("d",))
+mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("z",))
 step, _ = make_dist_step(plan, mesh, dtype=np.complex128)
 bf = np.empty_like(b)
 bf[plan.final_row] = b * plan.row_scale
