@@ -47,7 +47,9 @@ def ref_partial_lu(F, wb):
     return F
 
 
-_CHAIN = 8   # in-jit repetitions per dispatch
+_CHAIN = int(os.environ.get("SLU_AB_CHAIN", "8"))
+# in-jit repetitions per dispatch; SLU_AB_CHAIN=1 for interpret-mode
+# smoke runs where the chain's cost swamps the measurement anyway
 
 
 def time_fn(fn, F, reps=4):
@@ -84,10 +86,16 @@ def main():
     on_tpu = dev.platform == "tpu"
     print(f"# device: {dev.device_kind or dev.platform}", file=sys.stderr)
     rng = np.random.default_rng(0)
-    # bucket shapes spanning the schedule's range: (wb, mb, batch)
-    configs = [(8, 16, 512), (16, 32, 256), (32, 64, 128),
-               (64, 128, 64), (128, 256, 16), (256, 512, 4),
-               (512, 512, 2)]
+    # bucket shapes spanning the schedule's range: (wb, mb, batch);
+    # SLU_AB_CONFIGS="wb,mb,N;wb,mb,N" overrides (interpret smoke)
+    cfg_env = os.environ.get("SLU_AB_CONFIGS", "")
+    if cfg_env:
+        configs = [tuple(int(v) for v in c.split(","))
+                   for c in cfg_env.split(";") if c]
+    else:
+        configs = [(8, 16, 512), (16, 32, 256), (32, 64, 128),
+                   (64, 128, 64), (128, 256, 16), (256, 512, 4),
+                   (512, 512, 2)]
     results = []
     for wb, mb, N in configs:
         if not usable(mb, np.float32):
