@@ -36,9 +36,9 @@ def main():
     from superlu_dist_tpu.plan.plan import plan_factorization
     from superlu_dist_tpu.utils.testmat import laplacian_3d
 
+    from superlu_dist_tpu.utils.warmup import warmup_staged
+
     k = int(os.environ.get("SLU_SCALE_K", "100"))
-    dtype = np.dtype(np.float32)
-    rdt = B._real_dtype(dtype)
 
     t0 = time.perf_counter()
     a = laplacian_3d(k)
@@ -49,62 +49,18 @@ def main():
     sched = B.build_schedule(plan, ndev=1)
     t_sched = time.perf_counter() - t0
 
-    # distinct STATIC signatures: what the staged jit cache is keyed
-    # by, plus the dynamic-operand shapes (index-array lengths) that
-    # also key the executable
-    def sds(x):
-        x = np.asarray(x)
-        return jax.ShapeDtypeStruct(x.shape, x.dtype)
-
-    def aval(x):
-        """(shape, dtype) — what actually keys the jit executable
-        cache; dtype matters because dev() picks int32 vs int64 per
-        group by span."""
-        x = np.asarray(x)
-        return (x.shape, str(x.dtype))
-
-    fsigs, ssigs = {}, {}
-    for g in sched.groups:
-        a_src, a_dst, one_dst, ea_blocks, ci, si = g.dev(squeeze=True)
-        ea_avals = tuple(jax.tree_util.tree_leaves(
-            jax.tree_util.tree_map(aval, ea_blocks,
-                                   is_leaf=lambda x: hasattr(x, "dtype"))))
-        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, aval(a_src),
-                aval(a_dst), aval(one_dst), ea_avals)
-        fsigs.setdefault(fkey, g)
-        skey = (g.mb, g.wb, g.n_loc, aval(ci), aval(si))
-        ssigs.setdefault(skey, g)
-
-    t0 = time.perf_counter()
-    for (mb, wb, n_pad, ea_meta, *_), g in fsigs.items():
-        a_src, a_dst, one_dst, ea_blocks, _, _ = g.dev(squeeze=True)
-        ea_blocks = jax.tree_util.tree_map(sds, ea_blocks)
-        B._staged_factor_group.lower(
-            jax.ShapeDtypeStruct((sched.upd_total + 1,), dtype),
-            jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
-            jax.ShapeDtypeStruct((), rdt),
-            sds(a_src), sds(a_dst), sds(one_dst), ea_blocks,
-            jax.ShapeDtypeStruct((), np.int64),
-            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta).compile()
-    nrhs = 1
-    for (mb, wb, n_pad, ci_a, si_a), g in ssigs.items():
-        for kind in ("fwd", "bwd"):   # each kind is its own executable
-            B._staged_sweep_group.lower(
-                jax.ShapeDtypeStruct((sched.n + 1, nrhs), dtype),
-                jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
-                jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
-                jax.ShapeDtypeStruct(ci_a[0], np.dtype(ci_a[1])),
-                jax.ShapeDtypeStruct(si_a[0], np.dtype(si_a[1])),
-                mb=mb, wb=wb, n_pad=n_pad, cplx=False,
-                kind=kind).compile()
-    t_compile = time.perf_counter() - t0
+    # the signature sweep IS the warmup utility (one copy of the
+    # dispatch-matching lowering recipe lives in utils/warmup.py);
+    # workers=1 so compile_s stays a sequential-cost measurement
+    rep = warmup_staged(plan, dtype="float32", rhs_dtype="float32",
+                        workers=1, force=True)
 
     print(json.dumps({
         "k": k, "n": a.n, "groups": len(sched.groups),
-        "factor_signatures": len(fsigs),
-        "sweep_signatures": len(ssigs),
+        "factor_programs": rep["factor_programs"],
+        "sweep_programs": rep["sweep_programs"],
         "plan_s": round(t_plan, 1), "schedule_s": round(t_sched, 1),
-        "compile_s": round(t_compile, 1),
+        "compile_s": rep["secs"],
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
