@@ -27,8 +27,11 @@ f32 (tools/pallas_ab.py) — and stalls the f64 iterative-refinement
 contract for conditioned matrices (cond·ε_factor must stay < 1,
 SURVEY.md §2.6).  Solvers sell accuracy classes, not matmul throughput;
 override with SLU_MATMUL_PREC=default|high|highest if you know better.
-No effect on CPU (native f32 there).
-"""
+An application that configured jax_default_matmul_precision BEFORE this
+import keeps its setting (the pin only fills an unset default; the hot
+factor path additionally scopes "float32" locally via _hi_prec, so the
+solver's own numerics never depend on the global).  No effect on CPU
+(native f32 there)."""
 
 import os as _os
 
@@ -36,8 +39,13 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
-_prec = _os.environ.get("SLU_MATMUL_PREC", "highest")
-if _prec != "default":
+_prec = _os.environ.get("SLU_MATMUL_PREC")
+if _prec is None and _jax.config.jax_default_matmul_precision is None:
+    # only pin when neither the embedding application (jax config) nor
+    # the operator (SLU_MATMUL_PREC) has chosen a precision — import
+    # order must not silently override an explicit app-wide setting
+    _jax.config.update("jax_default_matmul_precision", "highest")
+elif _prec is not None and _prec != "default":
     _jax.config.update("jax_default_matmul_precision", _prec)
 
 from .options import (  # noqa: E402

@@ -70,7 +70,7 @@ def factorize(a: CSRMatrix, options: Options | None = None,
               backend: str = "auto",
               user_perm_r: np.ndarray | None = None,
               user_perm_c: np.ndarray | None = None,
-              grid=None) -> LUFactorization:
+              grid=None, _phase: str = "FACT") -> LUFactorization:
     # caller's options win (numeric knobs may differ from the cached
     # plan's); fall back to the plan's when none are given
     if options is None:
@@ -98,7 +98,7 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             f"backend={backend!r} conflicts with grid=; pass "
             "backend='dist' (or 'auto') for mesh execution")
 
-    with stats.timer("FACT"):
+    with stats.timer(_phase):
         if backend == "host":
             host_lu = ref_multifrontal.factorize_host(
                 plan, scaled, dtype=np.dtype(options.factor_dtype))
@@ -135,7 +135,7 @@ def factorize(a: CSRMatrix, options: Options | None = None,
         else:
             raise ValueError(f"unknown backend {backend!r}")
     lu.options = options
-    stats.add_ops("FACT", plan.factor_flops)
+    stats.add_ops(_phase, plan.factor_flops)
     stats.lu_nnz = plan.lu_nnz()
     stats.lu_bytes = stats.lu_nnz * np.dtype(options.factor_dtype).itemsize
     return lu
@@ -374,8 +374,10 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
         # The plan is value-identical, so it is reused outright.
         stats.escalations += 1
         opts2 = options.replace(factor_dtype=options.refine_dtype)
+        # the rerun reports under FACT_ESC so FACT's GFLOP/s never
+        # blends two differently-precisioned factorizations
         lu = factorize(a, opts2, plan=lu.plan, stats=stats,
-                       backend=backend, grid=grid)
+                       backend=backend, grid=grid, _phase="FACT_ESC")
         x = solve(lu, b, stats=stats)
     return x, lu, stats
 

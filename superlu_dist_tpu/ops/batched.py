@@ -104,8 +104,12 @@ class GroupSpec:
     # schedule build time.)  Children are bucketed by padded rc; each
     # block is (src_off, stride, dst_base, pos) stacked (ndev, K[, rc_b])
     # with meta (rc_b, K, C): K padded child count, C fori_loop chunk.
-    ea_hosts: tuple            # per-bucket (src_off, stride, dst_base, pos)
-    ea_meta: tuple             # per-bucket (rc_b, K, C) static ints
+    # per-bucket (src_off, stride, dst_base, pos_row, pos_col); for
+    # ordinary groups pos_col IS pos_row (same array) — they diverge
+    # only for sharded-coop parents, whose destination columns are
+    # owned-slot indices instead of front positions
+    ea_hosts: tuple
+    ea_meta: tuple             # per-bucket (rc_b, tc_b, K, C) statics
     col_idx: np.ndarray        # (ndev, n_loc, wb) global cols, pad -> n
     struct_idx: np.ndarray     # (ndev, n_loc, mb-wb) pad -> n
     upd_off_global: int        # start of this group's global slab
@@ -123,6 +127,14 @@ class GroupSpec:
     # trailing GEMM is column-sharded (ops/coop_lu.py) — the TPU analog
     # of the reference's 2D block-cyclic panel distribution
     coop: bool = False
+    # sharded-coop layout (ops/coop_sharded.py; engaged when cp > 0):
+    # each device holds only its block-cyclic-owned columns of every
+    # front — slots [0, tp) owned trailing columns, [tp, cp) owned
+    # panel columns; pos_of_slot (ndev, n_loc, cp) maps slot → padded
+    # front position (sentinel mb for padding slots)
+    cp: int = 0
+    tp: int = 0
+    pos_of_slot: Optional[np.ndarray] = None
     # solve-sweep sync points (axis mode): X is reconciled by psum only
     # BEFORE groups that read rows other devices may have written —
     # fwd: some front has a cross-device descendant; bwd: a cross-
@@ -141,25 +153,33 @@ class GroupSpec:
         if self._dev is None:
             self._dev = {}
         if squeeze not in self._dev:
-            f_loc = self.n_loc * self.mb * self.mb
+            ncols = self.cp if self.cp > 0 else self.mb
+            f_loc = self.n_loc * self.mb * ncols
             fdt = jnp.int32 if f_loc < 2**31 - 1 else jnp.int64
             sdt = (jnp.int32 if int(self.a_src.max(initial=0)) < 2**31 - 1
                    else jnp.int64)
             eblocks = []
-            for (rc_b, K, C), (so, st, db, ps) in zip(self.ea_meta,
-                                                      self.ea_hosts):
+            for (rc_b, tc_b, K, C), (so, st, db, pr, pc) in zip(
+                    self.ea_meta, self.ea_hosts):
                 span = (int(so.max(initial=0))
-                        + int(st.max(initial=0)) * rc_b + rc_b)
+                        + int(st.max(initial=0)) * rc_b + tc_b)
                 edt = jnp.int32 if span < 2**31 - 1 else jnp.int64
+                prd = jnp.asarray(pr, dtype=jnp.int32)
                 eblocks.append((jnp.asarray(so, dtype=edt),
                                 jnp.asarray(st, dtype=edt),
                                 jnp.asarray(db, dtype=fdt),
-                                jnp.asarray(ps, dtype=jnp.int32)))
+                                prd,
+                                prd if pc is pr
+                                else jnp.asarray(pc, dtype=jnp.int32)))
+            pos = (self.pos_of_slot if self.pos_of_slot is not None
+                   else np.zeros((self.a_src.shape[0], 1, 1),
+                                 dtype=np.int32))
             arrs = (
                 jnp.asarray(self.a_src, dtype=sdt),
                 jnp.asarray(self.a_dst, dtype=fdt),
                 jnp.asarray(self.one_dst, dtype=fdt),
                 tuple(eblocks),
+                jnp.asarray(pos, dtype=jnp.int32),
                 jnp.asarray(self.col_idx, dtype=jnp.int32),
                 jnp.asarray(self.struct_idx, dtype=jnp.int32),
             )
@@ -203,10 +223,16 @@ class BatchedSchedule:
                        if g.needs_gather and g.mb > g.wb)
         coop_psum_b = coop_gather_b = 0
         for g in self.groups:
-            if g.coop:
-                # panel psums total mb·wb words regardless of the
-                # panel block size; the trailing all_gather moves each
-                # device's padded (mb, cb) column slice
+            if g.coop and g.cp > 0:
+                # sharded coop (ops/coop_sharded.py): panel psums
+                # total mb·wb words + the (wb, mb) U-stripe psum;
+                # the trailing Schur slice stays device-local, so
+                # there is NO recombination gather at all
+                coop_psum_b += g.n_loc * it * 2 * g.wb * g.mb
+            elif g.coop:
+                # legacy replicated coop (SLU_COOP_SHARDED=0): panel
+                # psums total mb·wb words; the trailing all_gather
+                # moves each device's padded (mb, cb) column slice
                 cb = -(-g.mb // self.ndev)
                 coop_psum_b += g.n_loc * it * g.wb * g.mb
                 # the kernel gathers whenever wb < mbp (= cb·ndev):
@@ -284,6 +310,29 @@ def _coop_mb_min() -> int:
         return 256
 
 
+def _coop_sharded_on() -> bool:
+    """Sharded coop chain (ops/coop_sharded.py) vs the legacy
+    replicated scheme (ops/coop_lu.py).  Default ON — the replicated
+    scheme's recombination gather was measured at ~64% of step traffic
+    at 16 devices (tests/test_coop16.py); SLU_COOP_SHARDED=0 restores
+    it for A/B."""
+    import os
+    return os.environ.get("SLU_COOP_SHARDED", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _coop_block() -> int:
+    """Block size B of the global-column block-cyclic ownership map
+    owner(g) = (g // B) % ndev (SRC/superlu_defs.h:357-382 analog).
+    B=1 (pure cyclic) maximizes balance on the arbitrary struct-column
+    subsets fronts carry; SLU_COOP_B overrides."""
+    import os
+    try:
+        return max(1, int(os.environ.get("SLU_COOP_B", "1")))
+    except (TypeError, ValueError):
+        return 1
+
+
 def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     fp = plan.frontal
     part = fp.sym.part
@@ -311,6 +360,18 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     group_alloc: dict = {}           # group idx -> (offset, size)
     remaining: dict = {}             # group idx -> unconsumed fronts
     group_of_sup: dict = {}          # front -> group idx
+
+    # sharded-coop bookkeeping (ops/coop_sharded.py): block-cyclic
+    # ownership on GLOBAL column ids makes coop→coop extend-adds
+    # device-local (DESIGN.md §5 successor design)
+    sh_mode = _coop_sharded_on()
+    cyc_B = _coop_block()
+    sharded_sup = np.zeros(fp.nsuper, dtype=bool)
+    sup_slab_stride = np.zeros(fp.nsuper, dtype=np.int64)  # slab cols
+    sharded_trail: dict = {}   # front -> [per-d array of struct idx]
+
+    def _owner(gids):
+        return (np.asarray(gids, dtype=np.int64) // cyc_B) % ndev
 
     def _free(gi: int):
         off, size = group_alloc[gi]
@@ -357,12 +418,23 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             rb = mb - wb
 
             # tree-top groups with fewer fronts than half the devices
-            # factor cooperatively: the front replicates on every
-            # device and its trailing GEMM shards by column slices
-            # (ops/coop_lu.py) — the 2D-block-cyclic-panel analog that
-            # removes the one-device-factors-the-root Amdahl cap
-            coop = (ndev > 1 and coop_min > 0 and mb >= coop_min
-                    and 2 * N <= ndev)
+            # factor cooperatively: every device participates in every
+            # front, with the trailing GEMM column-sharded
+            # (ops/coop_sharded.py; legacy replicated ops/coop_lu.py)
+            # — the 2D-block-cyclic-panel analog that removes the
+            # one-device-factors-the-root Amdahl cap.  In sharded mode
+            # coop is FORCED on any group whose fronts consume a
+            # sharded child slab (the slab is device-local, so only a
+            # sharded parent can assemble it without a gather); the
+            # chain therefore runs coop all the way to the root.
+            has_coop_child = sh_mode and any(
+                sharded_sup[int(c)]
+                for s in slist for c in fp.sym.children[s]
+                if fp.r[int(c)] > 0)
+            coop = (ndev > 1 and coop_min > 0
+                    and ((mb >= coop_min and 2 * N <= ndev)
+                         or has_coop_child))
+            sharded = coop and sh_mode
             if coop:
                 per_dev_s = [list(slist) for _ in range(ndev)]
                 maxc = N
@@ -398,7 +470,43 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             # pad per-device count to the {2^k, 1.5·2^k} grid
             n_loc = _next_bucket(maxc)
             n_tot = n_loc * ndev
-            f_loc = n_loc * mb * mb
+
+            # sharded-coop ownership layout: per front, per device,
+            # the owned columns under owner(g) = (g // B) % ndev on
+            # GLOBAL column ids (panel columns are contiguous from
+            # xsup; trailing columns are the struct set; padding panel
+            # columns w..wb get virtual ids continuing the run so
+            # every slot has exactly one owner)
+            tp = cp = 0
+            pos_of_slot = None
+            if sharded:
+                trail_lists, panel_lists = [], []
+                max_t = max_p = 0
+                for s in slist:
+                    r = int(fp.r[s])
+                    own_p = _owner(xsup[s] + np.arange(wb))
+                    own_t = (_owner(fp.sym.struct[s]) if r
+                             else np.empty(0, np.int64))
+                    tl = [np.flatnonzero(own_t == d)
+                          for d in range(ndev)]
+                    pl = [np.flatnonzero(own_p == d)
+                          for d in range(ndev)]
+                    max_t = max([max_t] + [len(v) for v in tl])
+                    max_p = max([max_p] + [len(v) for v in pl])
+                    trail_lists.append(tl)
+                    panel_lists.append(pl)
+                dummy_panel = [np.flatnonzero(_owner(np.arange(wb))
+                                              == d)
+                               for d in range(ndev)]
+                if n_loc > N:
+                    max_p = max([max_p]
+                                + [len(v) for v in dummy_panel])
+                tp = _next_bucket(max_t) if max_t else 0
+                cp = tp + _next_bucket(max_p)
+                pos_of_slot = np.full((ndev, n_loc, cp), mb,
+                                      dtype=np.int64)
+            ncols = cp if sharded else mb
+            f_loc = n_loc * mb * ncols
 
             # consume child slabs (each front is extend-added exactly
             # once, here); fully-consumed groups free their slab for
@@ -412,10 +520,12 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         remaining[gc] -= 1
                         if remaining[gc] == 0:
                             _free(gc)
-            # coop groups keep ONE (owner-slot) copy of their slab:
-            # every device writes the identical replicated content at
-            # the same offset, so no device-major fan-out is needed
-            slab_sz = (n_loc if coop else n_tot) * rb * rb
+            # sharded coop groups keep only the device-local owned
+            # trailing slice (rb × tp) per front; legacy coop groups
+            # keep ONE (owner-slot) replicated copy; ordinary groups a
+            # device-major global fan-out
+            slab_sz = (n_loc * rb * tp if sharded
+                       else (n_loc if coop else n_tot) * rb * rb)
             upd_off = _alloc(slab_sz)
 
             sup_pos = np.empty(len(slist), dtype=np.int64)
@@ -432,14 +542,37 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 for b, s in enumerate(per_dev_s[d]):
                     bg = d * n_loc + b
                     w = int(fp.w[s]); r = int(fp.r[s])
-                    base = b * mb * mb
+                    base = b * mb * ncols
                     lr = _pad_pos(fp.a_lr[s], w, wb)
                     lc = _pad_pos(fp.a_lc[s], w, wb)
-                    per_dev["a_src"][d].append(fp.a_src[s])
-                    per_dev["a_dst"][d].append(base + lr * mb + lc)
-                    if wb > w:
-                        t = np.arange(w, wb)
-                        per_dev["one"][d].append(base + t * mb + t)
+                    if sharded:
+                        # position → owned slot map for (d, front):
+                        # slots [0, tp) trailing, [tp, cp) panel
+                        fi = pos_of[s]
+                        tl = trail_lists[fi][d]
+                        pl = panel_lists[fi][d]
+                        sl_arr = np.full(mb + 1, -1, dtype=np.int64)
+                        sl_arr[wb + tl] = np.arange(len(tl))
+                        sl_arr[pl] = tp + np.arange(len(pl))
+                        pos_of_slot[d, b, :len(tl)] = wb + tl
+                        pos_of_slot[d, b, tp:tp + len(pl)] = pl
+                        slt = sl_arr[lc]
+                        keep = slt >= 0
+                        per_dev["a_src"][d].append(fp.a_src[s][keep])
+                        per_dev["a_dst"][d].append(
+                            base + lr[keep] * ncols + slt[keep])
+                        if wb > w:
+                            t = np.arange(w, wb)
+                            ts = sl_arr[t]
+                            k2 = ts >= 0
+                            per_dev["one"][d].append(
+                                base + t[k2] * ncols + ts[k2])
+                    else:
+                        per_dev["a_src"][d].append(fp.a_src[s])
+                        per_dev["a_dst"][d].append(base + lr * mb + lc)
+                        if wb > w:
+                            t = np.arange(w, wb)
+                            per_dev["one"][d].append(base + t * mb + t)
                     for c in fp.sym.children[s]:
                         rc = int(fp.r[c])
                         if rc == 0:
@@ -447,11 +580,40 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         rbc = int(fp.mb[c]) - int(fp.wb[c])
                         coff = sup_upd_off[c]
                         assert coff >= 0, "child scheduled after parent"
-                        child_recs[d].append(
-                            (rc, int(coff), rbc, base,
-                             _pad_pos(fp.ea_map[c], w, wb)))
+                        ps_row = _pad_pos(fp.ea_map[c], w, wb)
+                        if not sharded:
+                            # slab columns ARE front positions: pos_col
+                            # aliases pos_row (a sharded child under a
+                            # non-sharded parent cannot occur — coop is
+                            # forced up the chain)
+                            assert not sharded_sup[int(c)]
+                            child_recs[d].append(
+                                (rc, int(coff), rbc, base,
+                                 ps_row, ps_row, rc))
+                        elif sharded_sup[int(c)]:
+                            # device-local child slice (rbc, tp_c):
+                            # owned columns align with this device's
+                            # owned parent columns BY CONSTRUCTION
+                            # (same global column id)
+                            jl = sharded_trail[int(c)][d]
+                            pcl = sl_arr[ps_row[jl]]
+                            assert (pcl >= 0).all(), \
+                                "sharded coop ownership misaligned"
+                            child_recs[d].append(
+                                (rc, int(coff),
+                                 int(sup_slab_stride[int(c)]), base,
+                                 ps_row, pcl, len(jl)))
+                        else:
+                            # replicated (gathered) child slab, full
+                            # square: this device extend-adds only the
+                            # columns it owns; unowned → sentinel
+                            pcl = sl_arr[ps_row]
+                            pcl = np.where(pcl < 0, ncols, pcl)
+                            child_recs[d].append(
+                                (rc, int(coff), rbc, base,
+                                 ps_row, pcl, rc))
                     if coop and d > 0:
-                        # replicated fronts: factor work is shared, but
+                        # coop fronts: factor work is shared, but
                         # ownership (slab slot, solve updates, diag-U
                         # extraction) is pinned to device 0 — solve
                         # indices stay dummies off-owner so the psum of
@@ -462,30 +624,45 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                     # global update slab is device-major contiguous so an
                     # all_gather of local slabs reproduces it exactly
                     # (coop slabs: single owner-slot copy, bg = b)
-                    sup_upd_off[s] = upd_off + (b if coop else bg) * rb * rb
+                    sup_upd_off[s] = upd_off + (b if coop else bg) \
+                        * rb * (tp if sharded else rb)
                     sup_dev[s] = d
                     sup_pos[pos_of[s]] = bg
+            if sharded:
+                for fi, s in enumerate(slist):
+                    sharded_sup[s] = True
+                    sup_slab_stride[s] = tp
+                    sharded_trail[int(s)] = trail_lists[fi]
             # dummy fronts (including wholly idle devices): identity
             # pivot block so the padded LU is well-defined
             for d in range(ndev):
                 for b in range(len(per_dev_s[d]), n_loc):
-                    t = np.arange(wb)
-                    per_dev["one"][d].append(b * mb * mb + t * mb + t)
+                    if sharded:
+                        dp = dummy_panel[d]
+                        pos_of_slot[d, b, tp:tp + len(dp)] = dp
+                        per_dev["one"][d].append(
+                            b * mb * ncols + dp * ncols
+                            + tp + np.arange(len(dp)))
+                    else:
+                        t = np.arange(wb)
+                        per_dev["one"][d].append(
+                            b * mb * mb + t * mb + t)
 
-            # bucket the child records by padded rc; K aligned across
-            # devices and rounded to the chunk size when chunked.  The
-            # chunk cap bounds the per-chunk transient gather/scatter
-            # tensors (C·rc_b² elements ≈ 16 MB int32).
+            # bucket the child records by (padded rc, padded source
+            # cols); K aligned across devices and rounded to the chunk
+            # size when chunked.  The chunk cap bounds the per-chunk
+            # transient gather/scatter tensors (~16 MB int32).
             by_rc: dict = {}
             for d in range(ndev):
                 for rec in child_recs[d]:
-                    by_rc.setdefault(_next_bucket(rec[0]),
-                                     [[] for _ in range(ndev)])[d].append(rec)
+                    key = (_next_bucket(rec[0]), _next_bucket(rec[6]))
+                    by_rc.setdefault(
+                        key, [[] for _ in range(ndev)])[d].append(rec)
             ea_hosts, ea_meta = [], []
-            for rc_b in sorted(by_rc):
-                per_d = by_rc[rc_b]
+            for (rc_b, tc_b) in sorted(by_rc):
+                per_d = by_rc[(rc_b, tc_b)]
                 K = _next_bucket(max(len(v) for v in per_d))
-                C = max(1, (1 << 22) // (rc_b * rc_b))
+                C = max(1, (1 << 22) // (rc_b * tc_b))
                 if K > C:
                     K = -(-K // C) * C
                 else:
@@ -493,17 +670,22 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 so = np.zeros((ndev, K), dtype=np.int64)
                 st = np.zeros((ndev, K), dtype=np.int64)
                 db = np.zeros((ndev, K), dtype=np.int64)
-                # pos == mb is the padding sentinel (dropped on device)
-                ps = np.full((ndev, K, rc_b), mb, dtype=np.int64)
+                # row pos == mb / col pos == ncols are the padding
+                # sentinels (dropped on device)
+                pr = np.full((ndev, K, rc_b), mb, dtype=np.int64)
+                pc = (pr if not sharded else
+                      np.full((ndev, K, tc_b), ncols, dtype=np.int64))
                 for d in range(ndev):
-                    for i, (rc, coff, rbc, base, pos) in \
-                            enumerate(per_d[d]):
+                    for i, (rc, coff, stride, base, ps_row, ps_col,
+                            tc) in enumerate(per_d[d]):
                         so[d, i] = coff
-                        st[d, i] = rbc
+                        st[d, i] = stride
                         db[d, i] = base
-                        ps[d, i, :rc] = pos
-                ea_hosts.append((so, st, db, ps))
-                ea_meta.append((rc_b, K, C))
+                        pr[d, i, :rc] = ps_row
+                        if sharded:
+                            pc[d, i, :tc] = ps_col
+                ea_hosts.append((so, st, db, pr, pc))
+                ea_meta.append((rc_b, tc_b, K, C))
 
             def stack(key, fill, distinct_pad=False):
                 """distinct_pad gives every padding slot its own
@@ -537,7 +719,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 col_idx=col_idx, struct_idx=struct_idx,
                 upd_off_global=upd_off,
                 L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur,
-                coop=coop))
+                coop=coop, cp=cp, tp=tp, pos_of_slot=pos_of_slot))
             gi = len(groups) - 1
             group_alloc[gi] = (upd_off, slab_sz)
             for s in slist:
@@ -616,9 +798,10 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     cache = getattr(plan, "_batched_schedules", None)
     if cache is None:
         cache = plan._batched_schedules = {}
-    # the coop threshold participates in the key so a mid-process
-    # SLU_COOP_MB change takes effect instead of hitting a stale entry
-    key = (ndev, _coop_mb_min() if ndev > 1 else 0)
+    # the coop knobs participate in the key so a mid-process
+    # SLU_COOP_* change takes effect instead of hitting a stale entry
+    key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block())
+           if ndev > 1 else 0)
     if key not in cache:
         cache[key] = build_schedule(plan, ndev)
     return cache[key]
@@ -682,21 +865,30 @@ def psum_exact(x, axis):
     return jax.lax.psum(x, axis)
 
 
-def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int):
+def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int,
+            ncols: int = 0):
     """Extend-add of child update blocks into the flat front batch F.
-    Outer-product form: per child only its O(rc) position vector ships
-    from the host; the rc² gather/scatter indices are iota arithmetic
-    on device.  Children are bucketed by padded rc; buckets with many
-    children run as a fori_loop over C-child chunks so the transient
-    index/update tensors stay bounded (~tens of MB) instead of
-    materializing a whole leaf level at once."""
-    f_loc = n_pad * mb * mb
+    Outer-product form: per child only its O(rc) position vectors ship
+    from the host; the rc·tc flat indices are iota arithmetic on
+    device.  Children are bucketed by padded (rc, tc); buckets with
+    many children run as a fori_loop over C-child chunks so the
+    transient index/update tensors stay bounded (~tens of MB) instead
+    of materializing a whole leaf level at once.
 
-    for (rc_b, K, C), (so, st, db, ps) in zip(ea_meta, ea_blocks):
+    `ncols` is the front's column count (mb for the square layout;
+    cp for sharded-coop owned-column slices, whose destination column
+    index is an owned SLOT from the separate pos_col vector)."""
+    if not ncols:
+        ncols = mb
+    f_loc = n_pad * mb * ncols
+
+    for (rc_b, tc_b, K, C), (so, st, db, pr, pc) in zip(ea_meta,
+                                                        ea_blocks):
         so = so.reshape(-1)
         st = st.reshape(-1)
         db = db.reshape(-1)
-        ps = ps.reshape(-1, ps.shape[-1])
+        pr = pr.reshape(-1, pr.shape[-1])
+        pc = pc.reshape(-1, pc.shape[-1])
         if upd_buf.size > np.iinfo(np.dtype(so.dtype)).max:
             # audikw_1-class slabs pass 2^31 elements: jax's gather
             # must represent the ARRAY SIZE in the index dtype (wrap
@@ -705,23 +897,25 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int):
             so = so.astype(jnp.int64)
             st = st.astype(jnp.int64)
 
-        def add_chunk(Ff, so, st, db, ps):
+        def add_chunk(Ff, so, st, db, pr, pc):
             ai = jnp.arange(rc_b, dtype=so.dtype)
+            aj = jnp.arange(tc_b, dtype=so.dtype)
             src = (so[:, None, None]
                    + ai[None, :, None] * st[:, None, None]
-                   + ai[None, None, :]).reshape(-1)
+                   + aj[None, None, :]).reshape(-1)
             upd = upd_buf[src]
-            pi = ps[:, :, None].astype(db.dtype)
-            pj = ps[:, None, :].astype(db.dtype)
-            dst = db[:, None, None] + pi * mb + pj
-            # pos == mb is the padding sentinel (real positions < mb);
-            # route those lanes out of bounds so mode="drop" kills them
-            dst = jnp.where((pi >= mb) | (pj >= mb),
+            pi = pr[:, :, None].astype(db.dtype)
+            pj = pc[:, None, :].astype(db.dtype)
+            dst = db[:, None, None] + pi * ncols + pj
+            # row pos == mb / col pos == ncols are padding sentinels
+            # (real positions are strictly smaller); route those lanes
+            # out of bounds so mode="drop" kills them
+            dst = jnp.where((pi >= mb) | (pj >= ncols),
                             jnp.asarray(f_loc, db.dtype), dst)
             return Ff.at[dst.reshape(-1)].add(upd, mode="drop")
 
         if K <= C:
-            F = add_chunk(F, so, st, db, ps)
+            F = add_chunk(F, so, st, db, pr, pc)
         else:
             def body(i, Ff):
                 s0 = i * C
@@ -730,7 +924,8 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int):
                     jax.lax.dynamic_slice_in_dim(so, s0, C, 0),
                     jax.lax.dynamic_slice_in_dim(st, s0, C, 0),
                     jax.lax.dynamic_slice_in_dim(db, s0, C, 0),
-                    jax.lax.dynamic_slice_in_dim(ps, s0, C, 0))
+                    jax.lax.dynamic_slice_in_dim(pr, s0, C, 0),
+                    jax.lax.dynamic_slice_in_dim(pc, s0, C, 0))
             F = jax.lax.fori_loop(0, K // C, body, F)
     return F
 
@@ -742,10 +937,13 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        ea_meta: tuple = (),
                        axis: Optional[str] = None,
                        gather: bool = True, coop: bool = False,
-                       ndev: int = 1):
+                       ndev: int = 1, pos_idx=None, cp: int = 0,
+                       tp: int = 0):
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
-    F = jnp.zeros(n_pad * mb * mb, dtype)
+    sharded = coop and axis is not None and cp > 0
+    ncols = cp if sharded else mb
+    F = jnp.zeros(n_pad * mb * ncols, dtype)
     # a_dst/one_dst carry DISTINCT out-of-bounds padding, so the
     # unique-indices promise holds; add-scatter index pairs are
     # dst-sorted by the schedule builder, so they also promise
@@ -753,27 +951,42 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[a_dst].add(vals[a_src], mode="drop",
                         unique_indices=True, indices_are_sorted=True)
     F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
-    F = _ea_add(F, upd_buf, ea_blocks, ea_meta, mb=mb, n_pad=n_pad)
-    F = F.reshape(n_pad, mb, mb)
+    F = _ea_add(F, upd_buf, ea_blocks, ea_meta, mb=mb, n_pad=n_pad,
+                ncols=ncols)
+    F = F.reshape(n_pad, mb, ncols)
 
-    if coop and axis is not None:
-        # replicated tree-top fronts: cooperative column-sharded LU
-        # (the 2D-block-cyclic-panel analog); counters replicate, so
-        # take them from the owner device only
+    if sharded:
+        # sharded coop chain (ops/coop_sharded.py): each device holds
+        # only its block-cyclic-owned columns; panels replicate off
+        # psums, the Schur slice stays device-local (no recombination
+        # gather).  Counters replicate — owner device counts them.
+        from .coop_sharded import coop_sharded_lu_batch
+        Lsrc, Usrc, slab, tiny_g, nzero_g = coop_sharded_lu_batch(
+            F, pos_idx, thresh, wb=wb, cp=cp, tp=tp, axis=axis)
+        upd_src = slab
+        on_owner = (_flat_axis_index(axis) == 0).astype(jnp.int32)
+        tiny_g = tiny_g * on_owner
+        nzero_g = nzero_g * on_owner
+    elif coop and axis is not None:
+        # legacy replicated tree-top fronts (SLU_COOP_SHARDED=0):
+        # cooperative column-sharded LU over the full replicated
+        # front; counters replicate, so take them from the owner only
         from .coop_lu import coop_partial_lu_batch
         F, tiny_g, nzero_g = coop_partial_lu_batch(
             F, thresh, wb=wb, ndev=ndev, axis=axis)
         on_owner = (_flat_axis_index(axis) == 0).astype(jnp.int32)
         tiny_g = tiny_g * on_owner
         nzero_g = nzero_g * on_owner
+        Lsrc, Usrc, upd_src = F[:, :, :wb], F[:, :wb, :], F[:, wb:, wb:]
     else:
         F, tiny_g, nzero_g = partial_lu_batch(F, thresh, wb=wb)
+        Lsrc, Usrc, upd_src = F[:, :, :wb], F[:, :wb, :], F[:, wb:, wb:]
 
     rows = jnp.arange(mb)[:, None]
     colsw = jnp.arange(wb)[None, :]
-    Lpanel = jnp.where(rows > colsw, F[:, :, :wb],
+    Lpanel = jnp.where(rows > colsw, Lsrc,
                        jnp.where(rows == colsw, one, 0))
-    Upanel = jnp.where(colsw.T <= jnp.arange(mb)[None, :], F[:, :wb, :], 0)
+    Upanel = jnp.where(colsw.T <= jnp.arange(mb)[None, :], Usrc, 0)
     Li = unit_lower_inverse(Lpanel[:, :wb, :])
     Ui = upper_inverse(Upanel[:, :, :wb])
 
@@ -785,12 +998,15 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                                            (Li_off,))
     Ui_flat = jax.lax.dynamic_update_slice(Ui_flat, Ui.reshape(-1),
                                            (Ui_off,))
-    if mb > wb:
-        upd = F[:, wb:, wb:].reshape(-1)
+    if mb > wb and (not sharded or tp > 0):
+        upd = upd_src.reshape(-1)
         if axis is not None and coop:
-            # replicated coop content: every device writes the SAME
-            # values at the single owner-slot offset, so consumers on
-            # any device read it locally — no gather ever needed
+            # coop content at the single owner-slot offset: sharded —
+            # each device writes its OWN (rb, tp) owned-column slice
+            # (device-varying, consumed device-locally by the sharded
+            # parent); legacy replicated — every device writes the
+            # SAME full square, so consumers read it locally either
+            # way and no gather is ever needed
             off = upd_off
         elif axis is not None and gather:
             # ancestor propagation: the reference's dreduceAncestors3d /
@@ -1076,7 +1292,7 @@ def _staged_factor_run(sched, vals, thresh_np, dtype):
     panels = []
     tiny = nzero = jnp.zeros((), jnp.int32)
     for g in sched.groups:
-        a_src, a_dst, one_dst, ea_blocks, _, _ = g.dev(squeeze=True)
+        a_src, a_dst, one_dst, ea_blocks = g.dev(squeeze=True)[:4]
         (upd_buf, L, U, Li, Ui, t, z) = _staged_factor_group(
             upd_buf, vals_ext, thresh, a_src, a_dst, one_dst,
             ea_blocks, jnp.asarray(g.upd_off_global, jnp.int64),
@@ -1103,12 +1319,12 @@ def _staged_sweeps(sched, panels, bf, dtype, trans: bool):
     bidx, biidx = (0, 2) if trans else (1, 3)
     fkind, bkind = ("fwdT", "bwdT") if trans else ("fwd", "bwd")
     for g, p in zip(sched.groups, panels):
-        _, _, _, _, ci, si = g.dev(squeeze=True)
+        ci, si = g.dev(squeeze=True)[5:7]
         X = _staged_sweep_group(X, p[fidx], p[fiidx], ci, si,
                                 mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                                 cplx=cplx, kind=fkind)
     for g, p in zip(reversed(sched.groups), reversed(panels)):
-        _, _, _, _, ci, si = g.dev(squeeze=True)
+        ci, si = g.dev(squeeze=True)[5:7]
         X = _staged_sweep_group(X, p[bidx], p[biidx], ci, si,
                                 mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                                 cplx=cplx, kind=bkind)
@@ -1174,7 +1390,7 @@ def _phase_fns(sched, dtype, thresh_np):
         return cache[key]
     from ..parallel.factor_dist import _factor_loop, _solve_loop
     per_group = [g.dev(squeeze=True) for g in sched.groups]
-    pairs = [(t[4], t[5]) for t in per_group]
+    pairs = [(t[5], t[6]) for t in per_group]
     dtype = np.dtype(dtype)
 
     @jax.jit
@@ -1283,8 +1499,8 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         tiny = jnp.zeros((), jnp.int32)
         nzero = jnp.zeros((), jnp.int32)
         for g in sched.groups:
-            a_src, a_dst, one_dst, ea_blocks, _, _ = \
-                g.dev(squeeze=True)
+            a_src, a_dst, one_dst, ea_blocks = \
+                g.dev(squeeze=True)[:4]
             (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
              nzero) = _factor_group_impl(
                     vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
@@ -1302,14 +1518,14 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         X = X.at[:sched.n, :].set(b.astype(xdt))
         X = _enc(X, cplx)
         for g in sched.groups:
-            _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            col_idx, struct_idx = g.dev(squeeze=True)[5:7]
             X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
                                 struct_idx, jnp.int32(g.L_off),
                                 jnp.int32(g.Li_off),
                                 mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                                 cplx=cplx)
         for g in reversed(sched.groups):
-            _, _, _, _, col_idx, struct_idx = g.dev(squeeze=True)
+            col_idx, struct_idx = g.dev(squeeze=True)[5:7]
             X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
                                 struct_idx, jnp.int32(g.U_off),
                                 jnp.int32(g.Ui_off),
@@ -1445,7 +1661,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     def _solve_once(flats, r, per_group):
         """r (original order, rdt) -> correction (original order, rdt)."""
         from ..parallel.factor_dist import _solve_loop
-        solve_idx = [(t[4], t[5]) for t in per_group]
+        solve_idx = [(t[5], t[6]) for t in per_group]
         y = _solve_loop(sched, tuple(flats), _pre_impl(r), dtype,
                         solve_idx, axis, trans=False)
         return _post_impl(y)
@@ -1585,7 +1801,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
 
     def mapped_body(vals, b, *idx_flat):
         from ..parallel.factor_dist import _regroup
-        return step_body(vals, b, _regroup(sched, idx_flat, 6))
+        return step_body(vals, b, _regroup(sched, idx_flat, 7))
 
     mapped = jax.shard_map(
         mapped_body, mesh=mesh,

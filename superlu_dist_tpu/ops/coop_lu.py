@@ -24,8 +24,10 @@ of the disjoint trailing column slices to recombine the Schur
 complement — ~mb² words over ICI, the same order as a single front
 broadcast, versus the reference's per-panel broadcasts.  The
 recombination broadcast is the price of the replicated-parent design;
-the measured 16-device share (tests/test_coop16.py) motivates the
-sharded coop-chain follow-up (DESIGN.md §5).
+it was measured at ~64% of step traffic at 16 devices, which is why
+this scheme is now the LEGACY path (SLU_COOP_SHARDED=0): the sharded
+coop chain (ops/coop_sharded.py, DESIGN.md §5) keeps Schur slices
+device-local and is the production default.
 
 The result F is bitwise identical on every device, so the caller's
 panel extraction, inverse preparation and slab writes run unchanged

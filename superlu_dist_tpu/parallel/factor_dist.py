@@ -88,7 +88,7 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
     tiny = jnp.zeros((), jnp.int32)
     nzero = jnp.zeros((), jnp.int32)
     for g, idx in zip(dsched.groups, per_group):
-        a_src, a_dst, one_dst, ea_blocks = idx[:4]
+        a_src, a_dst, one_dst, ea_blocks, pos_idx = idx[:5]
         (upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
          nzero) = _factor_group_impl(
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
@@ -98,7 +98,7 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
             ea_meta=g.ea_meta,
             axis=axis, gather=g.needs_gather, coop=g.coop,
-            ndev=dsched.ndev)
+            ndev=dsched.ndev, pos_idx=pos_idx, cp=g.cp, tp=g.tp)
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
 
 
@@ -188,14 +188,14 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(6))
+    idx_args = _group_operands(dsched, range(7))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, b, *idx_flat):
-        per_group = _regroup(dsched, idx_flat, 6)
+        per_group = _regroup(dsched, idx_flat, 7)
         flats = _factor_loop(dsched, vals, thresh_np, dtype,
                              per_group, axis)[:4]
-        solve_idx = [(t[4], t[5]) for t in per_group]
+        solve_idx = [(t[5], t[6]) for t in per_group]
         return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
                            trans=False)
 
@@ -242,11 +242,11 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(4))
+    idx_args = _group_operands(dsched, range(5))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, *idx_flat):
-        per_group = _regroup(dsched, idx_flat, 4)
+        per_group = _regroup(dsched, idx_flat, 5)
         L, U, Li, Ui, tiny, nzero = _factor_loop(
             dsched, vals, thresh_np, dtype, per_group, axis)
         return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
@@ -279,7 +279,7 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dsched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
 
-    idx_args = _group_operands(dsched, (4, 5))
+    idx_args = _group_operands(dsched, (5, 6))
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_flat):

@@ -41,16 +41,51 @@ import numpy as np
 from ..plan.plan import FactorPlan, plan_factorization
 
 # wire format versioning: refuse to deserialize a plan produced by a
-# different package version (the payload is a pickle coupled to
-# FactorPlan's class layout, which can change with ANY release, so the
-# gate compares the package __version__ itself — a hand-bumped schema
-# constant would silently go stale)
+# different package version OR a same-version checkout whose dataclass
+# layout drifted.  The payload is a pickle coupled to FactorPlan's
+# class layout, so the gate is __version__ PLUS a structural
+# fingerprint (field names/types over the plan's nested dataclasses) —
+# two dev checkouts both claiming "0.1.0" with different layouts fail
+# here with a clear message instead of inside pickle.loads.  The
+# pickle channel itself must be TRUSTED (standard pickle caveat:
+# deserializing attacker-controlled bytes is code execution); the JAX
+# process group this rides is already a mutually-trusting SPMD job.
 _WIRE_MAGIC = b"SLUTPLAN"
+
+
+def _schema_fingerprint() -> str:
+    """Hash of the dataclass field layout reachable from FactorPlan
+    (names, declared types, class names, recursively)."""
+    import dataclasses
+    import hashlib
+    import typing
+
+    seen = set()
+    parts: list = []
+
+    def walk(cls):
+        if cls in seen or not dataclasses.is_dataclass(cls):
+            return
+        seen.add(cls)
+        parts.append(cls.__name__)
+        for f in dataclasses.fields(cls):
+            parts.append(f"{f.name}:{f.type}")
+            t = f.type
+            if isinstance(t, str):
+                # resolve forward refs against the defining module
+                t = getattr(__import__(cls.__module__, fromlist=["_"]),
+                            t.strip(), None)
+            for u in (t, *typing.get_args(t)):
+                if isinstance(u, type):
+                    walk(u)
+
+    walk(FactorPlan)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def _wire_version() -> bytes:
     from .. import __version__
-    return __version__.encode("ascii")
+    return f"{__version__}+{_schema_fingerprint()}".encode("ascii")
 
 
 def serialize_plan(plan: FactorPlan) -> bytes:
@@ -76,7 +111,8 @@ def deserialize_plan(data: bytes) -> FactorPlan:
         raise ValueError(
             f"serialized plan version {ver.decode('ascii', 'replace')}"
             f" != local {_wire_version().decode('ascii')}; hosts must "
-            "run the same superlu_dist_tpu version")
+            "run the same superlu_dist_tpu version AND FactorPlan "
+            "layout (version+schema fingerprint mismatch)")
     plan = pickle.loads(data[off + 4 + vlen:])
     if not isinstance(plan, FactorPlan):
         raise ValueError("payload is not a FactorPlan")

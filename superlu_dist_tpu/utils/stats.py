@@ -14,10 +14,13 @@ import time
 from typing import Dict
 
 
-# Phase keys mirroring PhaseType (SRC/superlu_enum_consts.h:66-90)
+# Phase keys mirroring PhaseType (SRC/superlu_enum_consts.h:66-90).
+# FACT_ESC is this build's addition: the precision-escalation rerun
+# (a second factorization at refine precision) reports separately so
+# FACT's GFLOP/s never blends two differently-precisioned runs.
 PHASES = (
     "EQUIL", "ROWPERM", "COLPERM", "ETREE", "SYMBFACT", "DIST",
-    "FACT", "SOLVE", "REFINE", "SPMV",
+    "FACT", "FACT_ESC", "SOLVE", "REFINE", "SPMV",
 )
 
 

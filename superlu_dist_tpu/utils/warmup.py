@@ -44,7 +44,8 @@ def staged_signatures(sched):
 
     fsigs, ssigs = {}, {}
     for g in sched.groups:
-        a_src, a_dst, one_dst, ea_blocks, ci, si = g.dev(squeeze=True)
+        a_src, a_dst, one_dst, ea_blocks, _pos, ci, si = \
+            g.dev(squeeze=True)
         ea_avals = tuple(jax.tree_util.tree_leaves(
             jax.tree_util.tree_map(
                 aval, ea_blocks, is_leaf=lambda x: hasattr(x, "dtype"))))
@@ -105,7 +106,7 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
 
     def compile_factor(item):
         (mb, wb, n_pad, ea_meta, *_), g = item
-        a_src, a_dst, one_dst, ea_blocks, _, _ = g.dev(squeeze=True)
+        a_src, a_dst, one_dst, ea_blocks = g.dev(squeeze=True)[:4]
         B._staged_factor_group.lower(
             jax.ShapeDtypeStruct((sched.upd_total + 1,), dtype),
             jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),

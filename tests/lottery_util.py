@@ -72,6 +72,13 @@ def run_double_draw(body: str, env_extra: dict | None = None,
                 "within-process failure (not a compile-lottery draw):"
                 "\n" + errs[-1])
         if attempt == 0:
+            # leave a trail: a real intermittent regression that loses
+            # only sometimes would otherwise vanish into the retry
+            # (p → p² silently).  pytest shows this with -rs/-s or on
+            # any later failure; CI logs always capture it.
+            print("lottery_util: first draw FAILED, retrying with a "
+                  "fresh compile cache; stderr tail:\n" + errs[-1],
+                  file=sys.stderr)
             shutil.rmtree(cache_dir, ignore_errors=True)
     raise AssertionError(
         "failed in two independent processes with a fresh compile "

@@ -107,16 +107,28 @@ def test_16dev_invariance_and_coop_share():
 
 def test_coop_traffic_accounted_at_16dev_bench_matrix():
     """On the bench-class matrix (3D Laplacian n=27k) with the
-    PRODUCTION coop threshold at 16 devices, the schedule's traffic
-    account must cover the replicated-coop broadcast cost.  Measured
-    truth today: the coop psums carry ~64% of step traffic — the 1-D
-    replicated-front coop scheme is broadcast-bound at 16 devices
-    (every device must receive the full tree-top Schur complements
-    because the parent front replicates).  This is the quantitative
-    case for the sharded coop-chain redesign (the reference's 2-D
-    block-cyclic map never replicates the parent, SRC/superlu_defs.h:
-    357-382); when it lands this assertion tightens to share < 0.20.
-    Pure schedule accounting, no device execution."""
+    PRODUCTION coop threshold at 16 devices, the sharded coop chain
+    (ops/coop_sharded.py) must hold the traffic gains it was built
+    for, versus the legacy replicated scheme (SLU_COOP_SHARDED=0):
+
+      * the Ω(mb²)-per-front trailing recombination gather is GONE
+        (coop_gather_bytes == 0 — Schur slices stay device-local and
+        coop→coop extend-adds are owner-aligned by construction);
+      * total predicted step traffic halves (measured at this pin:
+        380 MB → 184 MB, ratio 0.483);
+      * coop bytes drop ≥ 2x (261 MB → 102 MB).
+
+    What REMAINS is the asymptotic floor: 2·mb·wb words per coop
+    front — one pass of the panel columns (the reference's L-panel
+    column broadcast, SRC/pdgstrf.c:1108) plus one (wb, mb) U-stripe
+    psum (its U-panel row broadcast) — the same per-front movement
+    the reference's 2D block-cyclic map pays.  The share lands at
+    ~0.56, not the <0.20 the round-2 design sketch hoped for, because
+    the DENOMINATOR halved too (forced-coop conversion of tree-top
+    groups also removed their update-slab all_gathers); the absolute
+    numbers above are the real guarantee, the share bound below is a
+    regression backstop.  Pure schedule accounting, no device
+    execution."""
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.ops.batched import build_schedule
     from superlu_dist_tpu.plan.plan import plan_factorization
@@ -128,9 +140,27 @@ def test_coop_traffic_accounted_at_16dev_bench_matrix():
     sched = build_schedule(plan, 16)
     assert any(g.coop for g in sched.groups), \
         "tree-top coop must engage on the bench matrix at 16 devices"
-    cs = sched.comm_summary(np.float32)
-    coop_b = cs["coop_psum_bytes"] + cs["coop_gather_bytes"]
-    total = (cs["factor_allgather_bytes"] + coop_b
-             + cs["solve_sync_bytes"])
+    assert all(g.cp > 0 for g in sched.groups if g.coop), \
+        "sharded coop must be the production default"
+
+    def totals(s):
+        cs = s.comm_summary(np.float32)
+        coop_b = cs["coop_psum_bytes"] + cs["coop_gather_bytes"]
+        return (coop_b, cs["factor_allgather_bytes"] + coop_b
+                + cs["solve_sync_bytes"], cs)
+
+    coop_b, total, cs = totals(sched)
+    # the recombination gather is structurally eliminated
+    assert cs["coop_gather_bytes"] == 0
     share = coop_b / total
-    assert 0.0 < share < 0.80, f"coop share {share:.2%} of {total}"
+    assert 0.0 < share < 0.60, f"coop share {share:.2%} of {total}"
+    # versus the legacy replicated scheme: total halves, coop ≥ 2x
+    os.environ["SLU_COOP_SHARDED"] = "0"
+    try:
+        legacy = build_schedule(plan, 16)
+    finally:
+        del os.environ["SLU_COOP_SHARDED"]
+    lcoop_b, ltotal, lcs = totals(legacy)
+    assert lcs["coop_gather_bytes"] > 0   # the old scheme's broadcast
+    assert total < 0.55 * ltotal, (total, ltotal)
+    assert coop_b < 0.45 * lcoop_b, (coop_b, lcoop_b)

@@ -1,0 +1,105 @@
+"""Sharded coop chain (ops/coop_sharded.py) — layout invariants and
+the legacy-path A/B.
+
+The numeric oracle coverage for the production (sharded) path lives in
+tests/test_coop.py; this file pins the schedule-level properties the
+traffic win rests on (DESIGN.md §5), and keeps the legacy replicated
+path (SLU_COOP_SHARDED=0) executing against the oracle so the A/B
+escape hatch cannot rot."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from superlu_dist_tpu import Options, csr_from_scipy
+from superlu_dist_tpu.ops.batched import (factorize_device,
+                                          get_schedule, solve_device)
+from superlu_dist_tpu.parallel.factor_dist import make_dist_step
+from superlu_dist_tpu.parallel.grid import make_solver_mesh
+from superlu_dist_tpu.plan.plan import plan_factorization
+
+
+@pytest.fixture
+def force_coop(monkeypatch):
+    monkeypatch.setenv("SLU_COOP_MB", "32")
+
+
+def _problem(n1=40):
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(n1, n1))
+    A = sp.kronsum(t, t, format="csr")
+    a = csr_from_scipy(A)
+    rng = np.random.default_rng(0)
+    xtrue = rng.standard_normal((a.n, 2))
+    return a, A, xtrue, A @ xtrue
+
+
+def test_sharded_layout_invariants(force_coop):
+    """Ownership partitions every true front column exactly once; the
+    coop chain is closed upward (a sharded Schur slice is only ever
+    consumed by a sharded parent); no sharded group gathers."""
+    a, _, _, _ = _problem(40)
+    plan = plan_factorization(a, Options())
+    ndev = 8
+    sched = get_schedule(plan, ndev)
+    fp = plan.frontal
+    sparent = fp.sym.part.sparent
+    coop_groups = [g for g in sched.groups if g.coop]
+    assert coop_groups
+    coop_sups = {int(s) for g in coop_groups for s in g.sup_ids}
+    for g in coop_groups:
+        assert g.cp > 0 and g.pos_of_slot is not None
+        assert not g.needs_gather
+        # chain closure: every slab-producing coop front has a coop
+        # parent (coop is forced up to the root)
+        for s in g.sup_ids:
+            p = int(sparent[int(s)])
+            if p >= 0 and fp.r[int(s)] > 0:
+                assert p in coop_sups, (int(s), p)
+        # each true front position is owned by exactly one device
+        for b, s in enumerate(g.sup_ids[: g.n_true]):
+            w, r = int(fp.w[int(s)]), int(fp.r[int(s)])
+            pos = g.pos_of_slot[:, b, :]          # (ndev, cp)
+            real = pos[pos < g.mb]
+            # true panel positions 0..w and struct positions wb..wb+r
+            want = np.concatenate([np.arange(g.wb),
+                                   g.wb + np.arange(r)])
+            np.testing.assert_array_equal(np.sort(real), np.sort(want))
+        # trailing slots live in [0, tp), panel slots in [tp, cp)
+        tl = g.pos_of_slot[..., : g.tp]
+        pl = g.pos_of_slot[..., g.tp:]
+        assert ((tl >= g.wb) | (tl == g.mb)).all()
+        assert ((pl < g.wb) | (pl == g.mb)).all()
+
+
+def test_sharded_vs_legacy_comm_and_solution(force_coop, monkeypatch):
+    """The legacy replicated path still solves to oracle accuracy, and
+    the sharded default strictly removes its recombination gather on
+    the same schedule."""
+    a, A, xtrue, b = _problem(40)
+    plan = plan_factorization(a, Options())
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    lu1 = factorize_device(plan, vals)
+    x1 = solve_device(lu1, bf)
+
+    sched_sh = get_schedule(plan, 8)
+    cs_sh = sched_sh.comm_summary(np.float64)
+    assert cs_sh["coop_gather_bytes"] == 0
+
+    monkeypatch.setenv("SLU_COOP_SHARDED", "0")
+    sched_leg = get_schedule(plan, 8)
+    assert sched_leg is not sched_sh
+    cs_leg = sched_leg.comm_summary(np.float64)
+    assert cs_leg["coop_gather_bytes"] > 0
+    assert all(g.cp == 0 for g in sched_leg.groups)
+
+    g = make_solver_mesh(2, 2, 2)
+    step, sched_used = make_dist_step(plan, g.mesh)
+    assert sched_used is sched_leg
+    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"

@@ -243,9 +243,12 @@ def test_comm_summary_accounting():
 
 
 def test_comm_summary_coop_bytes(monkeypatch):
-    """Coop traffic accounting matches the collectives coop_lu
-    actually issues: wb/pb panel psums of (mb, pb) + one trailing
-    all_gather of the (mb, cb) column slices per front."""
+    """Coop traffic accounting matches the collectives the kernels
+    actually issue.  Sharded chain (default, ops/coop_sharded.py):
+    wb/pb panel psums of (mb, pb) + one (wb, mb) U-stripe psum per
+    front, NO gather.  Legacy replicated (SLU_COOP_SHARDED=0,
+    ops/coop_lu.py): the panel psums + one trailing all_gather of the
+    (mb, cb) column slices per front."""
     import scipy.sparse as sp
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.ops.batched import get_schedule
@@ -257,9 +260,23 @@ def test_comm_summary_coop_bytes(monkeypatch):
     t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(40, 40))
     a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
     plan = plan_factorization(a, Options())
+
     s = get_schedule(plan, 8)
     coop = [g for g in s.groups if g.coop]
-    assert coop
+    assert coop and all(g.cp > 0 for g in coop)
+    exp_psum = 0
+    for g in coop:
+        pb = _pick_pb(g.wb)
+        exp_psum += g.n_loc * ((g.wb // pb) * g.mb * pb
+                               + g.wb * g.mb) * 4
+    cs = s.comm_summary(np.float32)
+    assert cs["coop_psum_bytes"] == exp_psum
+    assert cs["coop_gather_bytes"] == 0
+
+    monkeypatch.setenv("SLU_COOP_SHARDED", "0")
+    s = get_schedule(plan, 8)
+    coop = [g for g in s.groups if g.coop]
+    assert coop and all(g.cp == 0 for g in coop)
     exp_psum = exp_gather = 0
     for g in coop:
         pb = _pick_pb(g.wb)

@@ -76,10 +76,10 @@ def test_extend_add_indexes_huge_slab():
 
     mb, n_pad, rc_b, K = 8, 2, 4, 3
     big = 2**31 + 128          # slab longer than int32 can address
-    ea_meta = ((rc_b, K, K),)
+    ea_meta = ((rc_b, rc_b, K, K),)
+    pos = jnp.zeros((K, rc_b), jnp.int32)
     ea_blocks = ((jnp.zeros(K, jnp.int32), jnp.ones(K, jnp.int32),
-                  jnp.zeros(K, jnp.int32),
-                  jnp.zeros((K, rc_b), jnp.int32)),)
+                  jnp.zeros(K, jnp.int32), pos, pos),)
     out = jax.eval_shape(
         functools.partial(_ea_add, ea_meta=ea_meta, mb=mb,
                           n_pad=n_pad),
