@@ -68,6 +68,11 @@ def _ensure_live_backend():
     missing plugin."""
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         return False, ""
+    if os.environ.get("SLU_BENCH_ASSUME_LIVE") == "1":
+        # the tunnel watcher (tools/tpu_fire.sh) probed liveness
+        # seconds ago; re-probing here would burn up to
+        # _PROBE_TIMEOUT × retries of a short hardware window
+        return False, ""
     import subprocess
     reason = ""
     for attempt in range(_PROBE_RETRIES + 1):
