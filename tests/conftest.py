@@ -39,3 +39,21 @@ jax.config.update("jax_compilation_cache_dir", host_cache_dir(
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "scale: target-scale end-to-end runs (≥10⁵ dof, ~30+ min on "
+        "a 1-core host) — excluded from the default suite; run with "
+        "`pytest -m scale`")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    if config.getoption("-m"):
+        return            # explicit -m selection is honored as given
+    skip = pytest.mark.skip(reason="scale run: opt in with -m scale")
+    for item in items:
+        if "scale" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,138 @@
+"""Target-scale end-to-end certification run (VERDICT r3 item 3).
+
+Executes a REAL ≥262k-dof factorization + solve — not a trace, not an
+eval_shape — through the exact production staged path (plan → schedule
+→ parallel compile warmup → staged per-group dispatch → sweeps → f64
+iterative refinement) and records the telemetry that certifies the
+audikw_1-class machinery (schedule build, int64 extend-add guards,
+liveness slab allocator, staged dispatch) survives at scale.  This is
+the envelope of BASELINE config #3 (EXAMPLE/pddrive3d.c, audikw_1
+n=943k) scaled to what one host executes in reasonable wall-clock;
+the reference's equivalent certification is its Summit batch scripts
+(example_scripts/batch_script_mpi_runit_summit_4k.sh).
+
+Writes ONE json file (SLU_SCALE_OUT, default SCALE_r04.json at the
+repo root) with phase wall-clocks, FACT GFLOP/s, berr/residual/relerr,
+refinement steps, peak RSS, slab accounting, and the staged program
+census.  Run:
+
+    JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python tools/scale_run.py
+    # k override: SLU_SCALE_K=64 (n = k^3)
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("SLU_STAGED", "1")   # the audikw_1-scale path
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = os.environ.get(
+        "SLU_SCALE_OUT", os.path.join(repo, "SCALE_r04.json"))
+
+    from superlu_dist_tpu.utils.cache import (ensure_portable_cpu_isa,
+                                              host_cache_dir)
+    os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+        os.environ.get("XLA_FLAGS", ""))
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      host_cache_dir(os.path.join(repo, ".jax_cache")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.models.gssvx import gssvx, query_space
+    from superlu_dist_tpu.ops import batched as B
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.stats import Stats
+    from superlu_dist_tpu.utils.testmat import (laplacian_3d,
+                                                manufactured_rhs)
+    from superlu_dist_tpu.utils.warmup import (staged_signatures,
+                                               warmup_staged)
+
+    k = int(os.environ.get("SLU_SCALE_K", "64"))
+    t_all = time.perf_counter()
+
+    t0 = time.perf_counter()
+    a = laplacian_3d(k)
+    xtrue, b = manufactured_rhs(a, nrhs=1)
+    t_build = time.perf_counter() - t0
+
+    opts = Options(factor_dtype="float32", refine_dtype="float64")
+
+    t0 = time.perf_counter()
+    plan = plan_factorization(a, opts)
+    t_plan = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sched = B.get_schedule(plan, 1)
+    t_sched = time.perf_counter() - t0
+    fsigs, ssigs = staged_signatures(sched)
+
+    wrep = warmup_staged(plan, dtype="float32", nrhs=1,
+                         rhs_dtype="float64")
+
+    stats = Stats()
+    t0 = time.perf_counter()
+    x, lu, stats = gssvx(opts, a, b, stats=stats)
+    t_numeric = time.perf_counter() - t0
+
+    x = np.asarray(x).reshape(xtrue.shape)
+    relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
+    asp = a.to_scipy()
+    r = asp @ x - b
+    # normwise residual with the reference pdgsrfs denominator class
+    resid = float(np.linalg.norm(r) / (
+        np.linalg.norm(b) + abs(asp).sum(axis=1).max()
+        * np.linalg.norm(x)))
+
+    rec = {
+        "k": k, "n": int(a.n), "nnz": int(a.nnz),
+        "factor_dtype": "float32", "refine_dtype": "float64",
+        "staged": True, "groups": len(sched.groups),
+        "factor_signatures": len(fsigs),
+        "sweep_signatures": len(ssigs),
+        "warmup": wrep,
+        "secs": {
+            "matrix_build": round(t_build, 2),
+            "plan": round(t_plan, 2),
+            "schedule": round(t_sched, 2),
+            "numeric_total": round(t_numeric, 2),
+            "wall_total": round(time.perf_counter() - t_all, 2),
+            "phases_ms": {p: round(v * 1e3, 1)
+                          for p, v in stats.utime.items() if v > 0},
+        },
+        "fact_gflops": round(stats.gflops("FACT"), 3),
+        "factor_flops": float(plan.factor_flops),
+        "berr": float(stats.berr),
+        "refine_steps": int(stats.refine_steps),
+        "escalations": int(stats.escalations),
+        "tiny_pivots": int(stats.tiny_pivots),
+        "relerr": relerr,
+        "residual": resid,
+        "slab": {
+            "upd_peak_elems": int(sched.upd_total),
+            **{kk: int(vv) for kk, vv in query_space(lu).items()},
+        },
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20,
+            2),
+        "platform": jax.devices()[0].platform,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    with open(out_path, "w") as f:
+        f.write(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
