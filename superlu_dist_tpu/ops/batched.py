@@ -145,14 +145,18 @@ class GroupSpec:
     bwd_sync: bool = True
     _dev: Optional[dict] = None  # lazy device-array cache, keyed by squeeze
 
-    def dev(self, squeeze: bool):
-        """Device copies of the index arrays (cached per `squeeze`).
+    def dev(self, squeeze: bool, with_a_src: bool = True):
+        """Device copies of the index arrays (cached per key).
         squeeze=True drops the leading ndev=1 axis for the
         single-device path.  Position 3 is the ea-block pytree (tuple
-        of per-bucket 4-tuples)."""
+        of per-bucket 4-tuples).  with_a_src=False leaves position 0
+        as None — for callers that substitute a remapped a_src
+        (factor_dist._sharded_factor_operands), so the global array is
+        never uploaded or cached."""
         if self._dev is None:
             self._dev = {}
-        if squeeze not in self._dev:
+        key = (squeeze, with_a_src)
+        if key not in self._dev:
             ncols = self.cp if self.cp > 0 else self.mb
             f_loc = self.n_loc * self.mb * ncols
             fdt = jnp.int32 if f_loc < 2**31 - 1 else jnp.int64
@@ -175,7 +179,8 @@ class GroupSpec:
                    else np.zeros((self.a_src.shape[0], 1, 1),
                                  dtype=np.int32))
             arrs = (
-                jnp.asarray(self.a_src, dtype=sdt),
+                jnp.asarray(self.a_src, dtype=sdt) if with_a_src
+                else None,
                 jnp.asarray(self.a_dst, dtype=fdt),
                 jnp.asarray(self.one_dst, dtype=fdt),
                 tuple(eblocks),
@@ -185,8 +190,8 @@ class GroupSpec:
             )
             if squeeze:
                 arrs = jax.tree_util.tree_map(lambda a: a[0], arrs)
-            self._dev[squeeze] = arrs
-        return self._dev[squeeze]
+            self._dev[key] = arrs
+        return self._dev[key]
 
 
 @dataclasses.dataclass
@@ -321,6 +326,27 @@ def _coop_sharded_on() -> bool:
         not in ("0", "false", "off")
 
 
+def _coop_solve_rotate() -> bool:
+    """Rotate coop fronts' solve/diag-U ownership across devices
+    (owner = supernode id % ndev; slot rotation would never leave
+    device 0 — tree-top groups hold ONE front) instead of pinning
+    device 0 (SLU_COOP_SOLVE_ROTATE=1).  Balances per-device MEANINGFUL solve
+    flops — the analog of pdgstrs distributing trisolve over the grid
+    per supernode (SRC/pdgstrs.c:1463,2133) — but buys NO wall-clock
+    on SPMD lockstep (every device executes identical-shaped sweep
+    einsums either way; sentinel masking only decides which results
+    are kept) and COSTS backward-sweep X-psums: the coop chain's bwd
+    interior is sync-free exactly because ownership never changes
+    between parent and child, while the fwd interior pays a psum per
+    coop level regardless (cross_desc is transitive from the
+    distributed subtrees below).  Default OFF by that cost model —
+    tests/test_coop16.py pins both designs' sync counts and the flop
+    balance this flag restores."""
+    import os
+    return os.environ.get("SLU_COOP_SOLVE_ROTATE", "0") \
+        .strip().lower() in ("1", "true", "on")
+
+
 def _coop_block() -> int:
     """Block size B of the global-column block-cyclic ownership map
     owner(g) = (g // B) % ndev (SRC/superlu_defs.h:357-382 analog).
@@ -366,6 +392,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     # device-local (DESIGN.md §5 successor design)
     sh_mode = _coop_sharded_on()
     cyc_B = _coop_block()
+    rotate = _coop_solve_rotate()
     sharded_sup = np.zeros(fp.nsuper, dtype=bool)
     sup_slab_stride = np.zeros(fp.nsuper, dtype=np.int64)  # slab cols
     sharded_trail: dict = {}   # front -> [per-d array of struct idx]
@@ -612,12 +639,16 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                             child_recs[d].append(
                                 (rc, int(coff), rbc, base,
                                  ps_row, pcl, rc))
-                    if coop and d > 0:
+                    if coop and d != (int(s) % ndev if rotate else 0):
                         # coop fronts: factor work is shared, but
                         # ownership (slab slot, solve updates, diag-U
-                        # extraction) is pinned to device 0 — solve
+                        # extraction) belongs to ONE device — solve
                         # indices stay dummies off-owner so the psum of
-                        # sweep deltas counts each front once
+                        # sweep deltas counts each front once.  Owner
+                        # is device 0 (default) or rotated by supernode
+                        # id (_coop_solve_rotate cost model; id, not
+                        # slot — tree-top groups hold ONE front, so a
+                        # slot rotation would never leave device 0).
                         continue
                     col_idx[d, b, :w] = np.arange(xsup[s], xsup[s] + w)
                     struct_idx[d, b, :r] = fp.sym.struct[s]
@@ -766,8 +797,11 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     # when other devices may have written rows it reads.  fwd reads
     # X[cols(s)], accumulated by s's DESCENDANTS; bwd reads
     # X[struct(s)] ⊆ ancestor columns, set by s's ANCESTORS.  Coop
-    # fronts run their solve updates on device 0 (sup_dev == 0), so
-    # the same device comparison covers them.
+    # fronts run their solve updates on their OWNER device (sup_dev:
+    # 0 pinned, or id-rotated under SLU_COOP_SOLVE_ROTATE), so the
+    # same device comparison covers them either way — rotation simply
+    # makes parent/child owner changes visible here and buys the bwd
+    # interior syncs its docstring costs out.
     if ndev > 1:
         ns = fp.nsuper
         cross_desc = np.zeros(ns, dtype=bool)
@@ -800,7 +834,8 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
         cache = plan._batched_schedules = {}
     # the coop knobs participate in the key so a mid-process
     # SLU_COOP_* change takes effect instead of hitting a stale entry
-    key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block())
+    key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block(),
+                  _coop_solve_rotate())
            if ndev > 1 else 0)
     if key not in cache:
         cache[key] = build_schedule(plan, ndev)

@@ -90,6 +90,41 @@ def test_coop_dist_step_matches_single_device(force_coop):
         f"max diff {np.abs(x - x1).max():.3e}"
 
 
+def test_coop_solve_rotation_matches_oracle(force_coop, monkeypatch):
+    """SLU_COOP_SOLVE_ROTATE=1 (coop solve ownership rotated across
+    devices — batched._coop_solve_rotate) must be numerically
+    invisible: the psum-of-diffs still counts each front exactly once
+    whoever owns it, so the rotated dist step equals the
+    single-device oracle bit-for-bit in structure.  Also checks
+    diag-U extraction survives rotated ownership."""
+    from superlu_dist_tpu.models.gssvx import factorize, get_diag_u
+    monkeypatch.setenv("SLU_COOP_SOLVE_ROTATE", "1")
+    a, A, xtrue, b = _problem(40)
+    plan = plan_factorization(a, Options())
+    sched = get_schedule(plan, 8)
+    coop = [g for g in sched.groups if g.coop]
+    assert coop
+    # rotation really moved ownership off device 0 somewhere
+    n = sched.n
+    owned_off0 = sum(int((g.col_idx[1:, :, 0] < n).sum())
+                     for g in coop)
+    assert owned_off0 > 0, "rotation did not move any coop ownership"
+    vals = plan.scaled_values(a.data)
+    bf = b[plan.final_row]
+    g = make_solver_mesh(2, 2, 2)
+    step, _ = make_dist_step(plan, g.mesh)
+    x = np.asarray(step(jnp.asarray(vals), jnp.asarray(bf)))
+    lu1 = factorize_device(plan, vals)
+    x1 = solve_device(lu1, bf)
+    assert np.allclose(x, x1, atol=1e-10), \
+        f"max diff {np.abs(x - x1).max():.3e}"
+    # diag-U ownership rides rotation too
+    lu_d = factorize(a, Options(), grid=g)
+    du = get_diag_u(lu_d)
+    assert np.allclose(du, get_diag_u(factorize(a, Options())),
+                       atol=1e-10)
+
+
 def test_coop_split_factor_solve(force_coop):
     a, A, xtrue, b = _problem(40)
     plan = plan_factorization(a, Options())

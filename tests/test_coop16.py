@@ -164,3 +164,70 @@ def test_coop_traffic_accounted_at_16dev_bench_matrix():
     assert lcs["coop_gather_bytes"] > 0   # the old scheme's broadcast
     assert total < 0.55 * ltotal, (total, ltotal)
     assert coop_b < 0.45 * lcoop_b, (coop_b, lcoop_b)
+
+
+def test_coop_solve_ownership_rotation_tradeoff(monkeypatch):
+    """Coop solve-update ownership (VERDICT r3 item 5): rotation
+    (SLU_COOP_SOLVE_ROTATE=1) balances per-device MEANINGFUL solve
+    flops across a 16-device schedule — the pdgstrs per-supernode
+    distributed-trisolve analog (SRC/pdgstrs.c:1463,2133) — with the
+    sweep group count unchanged.  The default stays owner-pinned
+    because the balance buys no SPMD wall-clock (every device executes
+    identical-shaped sweep einsums; sentinel masking only selects
+    which results survive the psum) while rotation COSTS backward
+    interior syncs: parent/child owner changes inside the coop chain
+    break the bwd elision the pinned design gets for free.  The fwd
+    side pays a psum per coop level under EITHER design (cross_desc is
+    transitive from the distributed subtrees).  This test pins all
+    three facts with schedule accounting — flop balance restored,
+    step count unchanged, the exact bwd sync cost."""
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import build_schedule
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    monkeypatch.delenv("SLU_COOP_SOLVE_ROTATE", raising=False)
+    a = laplacian_3d(16)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    pinned = build_schedule(plan, 16)
+    monkeypatch.setenv("SLU_COOP_SOLVE_ROTATE", "1")
+    rotated = build_schedule(plan, 16)
+
+    def coop_solve_flops(s):
+        """Per-device meaningful solve-update flops: mb·wb per OWNED
+        coop front (owner = the device whose col_idx row is real,
+        everyone else holds sentinels)."""
+        n = s.n
+        fl = np.zeros(s.ndev)
+        for g in s.groups:
+            if not g.coop:
+                continue
+            owned = (g.col_idx[:, :, 0] < n).sum(axis=1)  # (ndev,)
+            fl += owned * g.mb * g.wb
+        return fl
+
+    # sweep step count unchanged; coop census identical
+    assert len(rotated.groups) == len(pinned.groups)
+    assert ([g.coop for g in rotated.groups]
+            == [g.coop for g in pinned.groups])
+    fp_, fr = coop_solve_flops(pinned), coop_solve_flops(rotated)
+    assert fp_.sum() == fr.sum() > 0       # same total meaningful work
+    # pinned: device 0 owns ALL coop solve work
+    assert fp_[0] == fp_.sum() and (fp_[1:] == 0).all()
+    # rotated: useful work spreads over the chain.  Perfect balance is
+    # impossible — the root front is one indivisible atom and tree-top
+    # groups hold one front each — so the guarantees are (a) several
+    # devices own work, (b) the busiest device is bounded by the
+    # largest single front plus an even share of the rest.
+    atom = max(g.mb * g.wb for g in rotated.groups if g.coop)
+    assert (fr > 0).sum() >= 3, fr.tolist()
+    assert fr.max() <= atom + (fr.sum() - atom) / 2, \
+        (fr.tolist(), atom)
+    # sync cost model: fwd syncs identical (paid per coop level either
+    # way); rotation adds bwd syncs — the documented price of balance
+    fwd_p = sum(g.fwd_sync for g in pinned.groups)
+    fwd_r = sum(g.fwd_sync for g in rotated.groups)
+    bwd_p = sum(g.bwd_sync for g in pinned.groups)
+    bwd_r = sum(g.bwd_sync for g in rotated.groups)
+    assert fwd_r == fwd_p
+    assert bwd_r >= bwd_p, (bwd_r, bwd_p)
