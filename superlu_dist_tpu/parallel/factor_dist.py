@@ -176,6 +176,61 @@ def _group_operands(dsched, fields):
     return args
 
 
+def _vals_partition(dsched, nnz):
+    """Distributed numeric input (the NRformat_loc contract,
+    supermatrix.h:176-188): each device receives only the slice of A's
+    values its own groups assemble, not the whole array.  Every
+    original entry is extend-added into exactly one front, so the
+    per-device reference sets are disjoint except for replicated coop
+    fronts — total shipped ≈ nnz + coop shares, vs nnz × ndev for the
+    replicated input this replaces (the round-3 `in_specs=(P(),)`
+    ceiling; pddistribute.c:66 dReDistribute_A is the reference's
+    equivalent one-time redistribution).
+
+    Returns (sel, a_src_loc): `sel` (ndev, Lsel) global value indices
+    per device (pad slots repeat index 0 — never referenced), and per
+    group the (ndev, La) remap of its a_src into the device-local
+    slice, sentinel → Lsel (the appended zero slot, matching
+    _factor_loop's `concatenate([vals, 0])`)."""
+    ndev = dsched.ndev
+    refs = [[] for _ in range(ndev)]
+    for g in dsched.groups:
+        a = np.asarray(g.a_src)
+        for d in range(ndev):
+            v = a[d].ravel()
+            refs[d].append(v[v < nnz])
+    sels = [np.unique(np.concatenate(r)) if r else
+            np.zeros(0, np.int64) for r in refs]
+    lsel = max(max((s.size for s in sels), default=0), 1)
+    sel = np.zeros((ndev, lsel), dtype=np.int64)
+    for d, s in enumerate(sels):
+        sel[d, :s.size] = s
+    sdt = np.int32 if lsel < 2**31 - 1 else np.int64
+    a_src_loc = []
+    for g in dsched.groups:
+        a = np.asarray(g.a_src)
+        out = np.full(a.shape, lsel, dtype=sdt)
+        for d in range(ndev):
+            v = a[d]
+            m = v < nnz
+            out[d][m] = np.searchsorted(sels[d], v[m])
+        a_src_loc.append(jnp.asarray(out))
+    return sel, a_src_loc
+
+
+def _sharded_factor_operands(plan, dsched, per):
+    """(sel, idx_args) for a factor-group loop consuming per-device
+    value slices: group operand positions 0..per-1, with position 0
+    (a_src) replaced by its local-slice remap."""
+    sel, a_src_loc = _vals_partition(dsched, len(plan.coo_rows))
+    group_idx = [g.dev(squeeze=False, with_a_src=False)
+                 for g in dsched.groups]
+    idx_args = tuple(
+        a_src_loc[gi] if i == 0 else t[i]
+        for gi, t in enumerate(group_idx) for i in range(per))
+    return sel, idx_args
+
+
 def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                    axis=None):
     """Build the fused distributed factor+solve step:
@@ -188,25 +243,31 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(7))
+    sel, idx_args = _sharded_factor_operands(plan, dsched, 7)
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, b, *idx_flat):
         per_group = _regroup(dsched, idx_flat, 7)
-        flats = _factor_loop(dsched, vals, thresh_np, dtype,
+        flats = _factor_loop(dsched, vals[0], thresh_np, dtype,
                              per_group, axis)[:4]
         solve_idx = [(t[5], t[6]) for t in per_group]
         return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
                            trans=False)
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(), P()) + idx_specs,
+        body, mesh=mesh, in_specs=(P(axis), P()) + idx_specs,
         out_specs=P(), check_vma=False)
 
-    @jax.jit
-    def step(vals, b):
-        return mapped(vals, b, *idx_args)
+    jitted = jax.jit(lambda vsel, b: mapped(vsel, b, *idx_args))
 
+    def step(vals, b):
+        # host-side one-time redistribution (dReDistribute_A analog):
+        # each device's jit operand is its own value slice, not the
+        # whole array
+        return jitted(jnp.asarray(np.asarray(vals)[sel]), b)
+
+    step.jitted = jitted
+    step.sel = sel
     return step, dsched
 
 
@@ -242,24 +303,27 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    idx_args = _group_operands(dsched, range(5))
+    sel, idx_args = _sharded_factor_operands(plan, dsched, 5)
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, *idx_flat):
         per_group = _regroup(dsched, idx_flat, 5)
         L, U, Li, Ui, tiny, nzero = _factor_loop(
-            dsched, vals, thresh_np, dtype, per_group, axis)
+            dsched, vals[0], thresh_np, dtype, per_group, axis)
         return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
                 jax.lax.psum(nzero, axis))
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(),) + idx_specs,
+        body, mesh=mesh, in_specs=(P(axis),) + idx_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
-    jitted = jax.jit(lambda vals: mapped(vals, *idx_args))
+    jitted = jax.jit(lambda vsel: mapped(vsel, *idx_args))
 
     def factor(vals) -> DistLU:
-        L, U, Li, Ui, tiny, nzero = jitted(vals)
+        # host-side one-time redistribution (dReDistribute_A analog,
+        # pddistribute.c:66): ship each device ONLY its slice
+        L, U, Li, Ui, tiny, nzero = jitted(
+            jnp.asarray(np.asarray(vals)[sel]))
         if int(nzero) > 0:
             raise ZeroDivisionError(
                 f"{int(nzero)} exactly-zero pivot(s); matrix singular")
@@ -268,6 +332,7 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                       Ui_flat=Ui, tiny_pivots=int(tiny))
 
     factor.jitted = jitted  # exposed for HLO inspection (measure_comm)
+    factor.sel = sel        # per-device value-slice indices
     return factor
 
 
@@ -331,7 +396,7 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     # NOT the factor dtype (the cast happens inside the program); a
     # mismatched aval here would force a pointless full recompile
     vdt = np.complex128 if dlu.dtype.kind == "c" else np.float64
-    vals = jnp.zeros(len(plan.coo_rows), vdt)
+    vals = jnp.zeros(factor.sel.shape, vdt)   # per-device slices
     out = {}
     txt = factor.jitted.lower(vals).compile().as_text()
     out["FACT"] = hlo_collective_stats(txt)
