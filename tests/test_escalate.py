@@ -1,9 +1,9 @@
 """Precision escalation (gssvx _should_escalate): when a low-precision
-factor's iterative refinement stagnates above sqrt(eps(refine_dtype)),
-gssvx refactors once at refine precision — the safety net the
-psgssvx_d2 mixed-precision strategy (SRC/psgssvx_d2.c:516) leaves to
-the caller, automatic here because GESP has no mid-factor pivoting to
-fall back on."""
+factor's iterative refinement stagnates above the eps(refine_dtype)
+class (berr > 64·r_eps), gssvx refactors once at refine precision —
+the safety net the psgssvx_d2 mixed-precision strategy
+(SRC/psgssvx_d2.c:516) leaves to the caller, automatic here because
+GESP has no mid-factor pivoting to fall back on."""
 
 import numpy as np
 import pytest
@@ -55,6 +55,33 @@ def test_escalation_can_be_disabled():
     # without the net, the f32 factor's refinement stagnates far
     # above the f64 class — exactly the failure the default catches
     assert stats.berr > 1e-8
+
+
+def test_escalation_gate_class_boundary():
+    """The converged/stalled boundary is the refine-precision CLASS
+    (berr ≤ 64·eps(refine_dtype)), not sqrt(eps): an f32 factor whose
+    f64 refinement stalls at berr ≈ 1e-8 — sqrt-class, the round-3
+    gate's blind spot — MUST escalate, matching the reference's
+    berr ≈ eps contract (SRC/pdgsrfs.c:124).  Unit-level against
+    _escalation_core so the boundary is pinned exactly."""
+    from superlu_dist_tpu.models.gssvx import (_ESC_BERR_SLACK,
+                                               _escalation_core)
+    from superlu_dist_tpu.utils.stats import Stats
+
+    eps64 = np.finfo(np.float64).eps
+    opts = Options(factor_dtype="float32", refine_dtype="float64")
+
+    def gate(berr):
+        st = Stats()
+        st.berr = berr
+        return _escalation_core(opts, "float32", st)
+
+    assert gate(1e-8)                        # sqrt-class stall: escalate
+    assert gate(1e-13)                       # above class: escalate
+    assert not gate(eps64)                   # converged
+    assert not gate(_ESC_BERR_SLACK * eps64 * 0.99)   # inside class
+    assert gate(_ESC_BERR_SLACK * eps64 * 1.01)       # just outside
+    assert gate(float("nan")) and gate(float("inf"))  # overflow: escalate
 
 
 def test_no_escalation_when_contract_holds():
