@@ -259,12 +259,14 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         out_specs=P(), check_vma=False)
 
     jitted = jax.jit(lambda vsel, b: mapped(vsel, b, *idx_args))
+    vshard = jax.sharding.NamedSharding(mesh, P(axis))
 
     def step(vals, b):
         # host-side one-time redistribution (dReDistribute_A analog):
-        # each device's jit operand is its own value slice, not the
-        # whole array
-        return jitted(jnp.asarray(np.asarray(vals)[sel]), b)
+        # each device's jit operand is its own value slice, committed
+        # to its shard — never the whole array
+        return jitted(jax.device_put(np.asarray(vals)[sel], vshard),
+                      b)
 
     step.jitted = jitted
     step.sel = sel
@@ -318,12 +320,14 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
     jitted = jax.jit(lambda vsel: mapped(vsel, *idx_args))
+    vshard = jax.sharding.NamedSharding(mesh, P(axis))
 
     def factor(vals) -> DistLU:
         # host-side one-time redistribution (dReDistribute_A analog,
-        # pddistribute.c:66): ship each device ONLY its slice
+        # pddistribute.c:66): ship each device ONLY its slice,
+        # committed to its shard
         L, U, Li, Ui, tiny, nzero = jitted(
-            jnp.asarray(np.asarray(vals)[sel]))
+            jax.device_put(np.asarray(vals)[sel], vshard))
         if int(nzero) > 0:
             raise ZeroDivisionError(
                 f"{int(nzero)} exactly-zero pivot(s); matrix singular")

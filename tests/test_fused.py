@@ -191,3 +191,8 @@ def test_fused_solver_on_mesh():
               / np.linalg.norm(xtrue))
     assert relerr < 1e-10, relerr
     assert float(berr) < 1e-13
+    # the numeric input is sharded, not replicated (NRformat_loc
+    # analog): assembly slices per device, and each slice smaller
+    # than the whole value array
+    assert step.sel.shape[0] == 4
+    assert step.sel.shape[1] < len(plan.coo_rows)
