@@ -139,6 +139,108 @@ def _broadcast_bytes(data: bytes | None, is_source: bool) -> bytes:
     return payload.tobytes()
 
 
+def _assemble_row_slices(slices, m: int, n: int):
+    """Contiguous row blocks -> one global CSRMatrix.  `slices` is a
+    list of (fst_row, indptr_loc, indices_loc, data_loc) covering
+    [0, m) exactly once (any order).  Pure host assembly — the
+    reassembly half of the NRformat_loc contract
+    (supermatrix.h:176-188), shared by the single- and multi-process
+    paths so the wire code has no layout logic of its own."""
+    from ..sparse import CSRMatrix
+
+    # zero-row slices are legal NRformat_loc participants — drop them
+    # before the tiling check (their fst_row ties are meaningless)
+    slices = [s for s in slices if len(s[1]) > 1]
+    slices = sorted(slices, key=lambda s: s[0])
+    row = 0
+    for fst, ip, ix, dv in slices:
+        if np.asarray(ip)[0] != 0:
+            raise ValueError(
+                "each slice's indptr must be LOCAL (zero-based); got "
+                f"indptr[0] = {np.asarray(ip)[0]} for the slice at "
+                f"row {fst} — pass the rebased block, not a view of "
+                "the global indptr")
+        if len(ix) != len(dv):
+            raise ValueError(
+                f"slice at row {fst}: {len(ix)} indices vs "
+                f"{len(dv)} values")
+        if fst != row:
+            raise ValueError(
+                f"row slices must tile [0, {m}) contiguously: got a "
+                f"slice starting at {fst}, expected {row}")
+        row += len(ip) - 1
+    if row != m:
+        raise ValueError(f"row slices cover {row} rows, matrix has {m}")
+    indptr = np.zeros(m + 1, dtype=np.int64)
+    parts_i, parts_d = [], []
+    base = 0
+    r = 0
+    for _, ip, ix, dv in slices:
+        ip = np.asarray(ip, dtype=np.int64)
+        indptr[r + 1:r + len(ip)] = base + ip[1:]
+        base += int(ip[-1])
+        r += len(ip) - 1
+        parts_i.append(np.asarray(ix, dtype=np.int64))
+        parts_d.append(np.asarray(dv))
+    return CSRMatrix(m, n, indptr,
+                     np.concatenate(parts_i) if parts_i else
+                     np.zeros(0, np.int64),
+                     np.concatenate(parts_d) if parts_d else
+                     np.zeros(0))
+
+
+def csr_from_row_slices(indptr_loc, indices_loc, data_loc,
+                        fst_row: int, m: int, n: int | None = None):
+    """Distributed numeric input surface (the NRformat_loc contract,
+    supermatrix.h:176-188; fed to the reference's pdgssvx via
+    dCreate_CompRowLoc_Matrix_dist): every process passes its
+    CONTIGUOUS row block [fst_row, fst_row + m_loc) in local CSR form;
+    every process returns the assembled GLOBAL matrix.
+
+    Across processes the slices ride one all-gather over the JAX
+    process group (`multihost_utils.process_allgather`), then assemble
+    host-side — the gather-then-plan realization of the reference's
+    dReDistribute_A (pddistribute.c:66).  The deliberate delta to the
+    reference remains: the reference PLANS from distributed input
+    (psymbfact) while this build plans host-globally after the gather
+    — SURVEY row 17's recorded limit, traded for the shared-memory
+    native planning pipeline and bit-identical schedules everywhere.
+
+    Single-process: the slice must BE the whole matrix (fst_row 0,
+    m_loc == m) and is assembled directly."""
+    import jax
+
+    if n is None:
+        n = m
+    me = (int(fst_row), np.asarray(indptr_loc),
+          np.asarray(indices_loc), np.asarray(data_loc))
+    if jax.process_count() == 1:
+        return _assemble_row_slices([me], m, n)
+    from jax.experimental import multihost_utils
+
+    if len(me[2]) != len(me[3]):
+        raise ValueError(f"{len(me[2])} indices vs {len(me[3])} values")
+    # two-phase: one metadata gather (fst_row + lengths; shapes must
+    # match on every process), then the padded payload triple
+    meta = multihost_utils.process_allgather(
+        np.array([fst_row, len(me[1]), len(me[2])], np.int64))
+    max_ip = int(meta[:, 1].max())
+    max_nz = int(meta[:, 2].max())
+    ip_pad = np.zeros(max_ip, np.int64)
+    ip_pad[:len(me[1])] = me[1]
+    ix_pad = np.zeros(max_nz, np.int64)
+    ix_pad[:len(me[2])] = me[2]
+    dv_pad = np.zeros(max_nz, np.asarray(data_loc).dtype)
+    dv_pad[:len(me[3])] = me[3]
+    ips = multihost_utils.process_allgather(ip_pad)
+    ixs = multihost_utils.process_allgather(ix_pad)
+    dvs = multihost_utils.process_allgather(dv_pad)
+    slices = [(int(meta[p, 0]), ips[p, :int(meta[p, 1])],
+               ixs[p, :int(meta[p, 2])], dvs[p, :int(meta[p, 2])])
+              for p in range(jax.process_count())]
+    return _assemble_row_slices(slices, m, n)
+
+
 def plan_factorization_multihost(a, options=None, *, stats=None,
                                  autotune: bool | None = None
                                  ) -> FactorPlan:

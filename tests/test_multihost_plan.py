@@ -95,3 +95,70 @@ def test_single_process_degenerates_to_local_plan():
     plan = plan_factorization_multihost(a, Options())
     ref = plan_factorization(a, Options())
     _assert_plans_equal(plan, ref)
+
+
+def test_row_slice_assembly_matches_whole_matrix():
+    """csr_from_row_slices (NRformat_loc input surface,
+    supermatrix.h:176-188): slicing a matrix into contiguous row
+    blocks and reassembling is bit-identical to the original, in any
+    slice order, and the result plans/solves identically."""
+    from superlu_dist_tpu.parallel.multihost import (
+        _assemble_row_slices, csr_from_row_slices)
+    a = _testmat(12)
+    A = a.to_scipy()
+    cuts = [0, 37, 38, 90, A.shape[0]]
+    slices = []
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        blk = A[lo:hi]
+        slices.append((lo, blk.indptr, blk.indices, blk.data))
+    for order in (slices, slices[::-1]):
+        g = _assemble_row_slices(list(order), A.shape[0], A.shape[1])
+        assert np.array_equal(g.indptr, a.indptr)
+        assert np.array_equal(g.indices, a.indices)
+        assert np.array_equal(g.data, a.data)
+    # the single-process public surface requires the whole matrix
+    whole = csr_from_row_slices(a.indptr, a.indices, a.data,
+                                fst_row=0, m=a.m, n=a.n)
+    assert np.array_equal(whole.indptr, a.indptr)
+    p1 = plan_factorization(whole, Options())
+    p2 = plan_factorization(a, Options())
+    _assert_plans_equal(p1, p2)
+
+
+def test_row_slice_assembly_rejects_gaps():
+    from superlu_dist_tpu.parallel.multihost import _assemble_row_slices
+    a = _testmat(8)
+    A = a.to_scipy()
+    top, bot = A[:10], A[20:]
+    with pytest.raises(ValueError, match="contiguous"):
+        _assemble_row_slices(
+            [(0, top.indptr, top.indices, top.data),
+             (20, bot.indptr, bot.indices, bot.data)],
+            A.shape[0], A.shape[1])
+
+
+def test_row_slice_assembly_input_contracts():
+    """Zero-row slices are legal NRformat_loc participants; a global
+    (non-rebased) indptr view and mismatched indices/values are input
+    errors caught at the boundary, not silent corruption."""
+    from superlu_dist_tpu.parallel.multihost import _assemble_row_slices
+    a = _testmat(8)
+    A = a.to_scipy()
+    m, n = A.shape
+    mid = m // 2
+    top, bot = A[:mid], A[mid:]
+    empty = (0, np.zeros(1, np.int64), np.zeros(0, np.int64),
+             np.zeros(0))
+    g = _assemble_row_slices(
+        [empty, (0, top.indptr, top.indices, top.data),
+         (mid, bot.indptr, bot.indices, bot.data)], m, n)
+    assert np.array_equal(g.indptr, a.indptr)
+    assert g.indices.dtype == np.int64
+    with pytest.raises(ValueError, match="zero-based"):
+        _assemble_row_slices(
+            [(0, top.indptr, top.indices, top.data),
+             (mid, A.indptr[mid:], bot.indices, bot.data)], m, n)
+    with pytest.raises(ValueError, match="indices vs"):
+        _assemble_row_slices(
+            [(0, top.indptr, top.indices, top.data[:-1]),
+             (mid, bot.indptr, bot.indices, bot.data)], m, n)
