@@ -368,6 +368,106 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     return solve
 
 
+def make_dist_solve_rhs_sharded(plan: FactorPlan, mesh: Mesh,
+                                dtype=np.float64, axis=None,
+                                trans: bool = False):
+    """Many-RHS distributed solve: shard X by RHS COLUMNS instead of
+    replicating it.  Each device all_gathers the factor slabs ONCE
+    (device-major concatenation IS the global layout) and then sweeps
+    ALL fronts over its own column slice with ZERO collectives — the
+    many-RHS counterpart of pdgstrs's mrhs lsum kernels
+    (SRC/pdgstrs_lsum.c dlsum_fmod_inv_gpu_mrhs; baseline config #5,
+    ldoor nrhs=64).
+
+    Traffic trade vs the replicated-X sweep (`make_dist_solve`): one
+    lu_bytes-sized gather per solve instead of solve_syncs × n × nrhs
+    words of psum — the gather amortizes over RHS columns, so this
+    wins when nrhs is large (dist_solve auto-selects at
+    nrhs ≥ 2·ndev).  `b` (n, nrhs) in factor ordering; nrhs is padded
+    to a multiple of ndev internally."""
+    axis, ndev = _resolve_axis(mesh, axis)
+    dsched = get_schedule(plan, ndev)
+    dtype = np.dtype(dtype)
+    n = dsched.n
+
+    # per-group index tensors over ALL devices' fronts, device-major —
+    # matching the row order of the gathered slabs
+    g_idx = [(jnp.asarray(np.asarray(g.col_idx).reshape(
+                  ndev * g.n_loc, g.col_idx.shape[-1]), jnp.int32),
+              jnp.asarray(np.asarray(g.struct_idx).reshape(
+                  ndev * g.n_loc, g.struct_idx.shape[-1]), jnp.int32))
+             for g in dsched.groups]
+
+    def body(L_flat, U_flat, Li_flat, Ui_flat, b):
+        flats = [_solve_view(jax.lax.all_gather(f, axis, tiled=True))
+                 for f in (L_flat, U_flat, Li_flat, Ui_flat)]
+        L, U, Li, Ui = flats
+
+        def gsl(flat, off: int, size: int):
+            """Group slab across ALL devices, offset-0 contiguous
+            (device-major), in either solve storage."""
+            if flat.ndim == 2:          # (2, ndev*total) real view
+                return (flat.reshape(2, ndev, -1)[:, :, off:off + size]
+                        .reshape(2, ndev * size))
+            return (flat.reshape(ndev, -1)[:, off:off + size]
+                    .reshape(ndev * size))
+
+        xdt = jnp.promote_types(dtype, b.dtype)
+        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+        X = jnp.zeros((n + 1, b.shape[1]), xdt)
+        X = X.at[:n, :].set(b.astype(xdt))
+        X = _enc(X, cplx)
+        z = jnp.int32(0)
+
+        if not trans:
+            fwd_fn, fwd_src = _fwd_group_impl, (L, Li)
+            bwd_fn, bwd_src = _bwd_group_impl, (U, Ui)
+            fwd_off = lambda g: ((g.L_off, g.mb * g.wb),
+                                 (g.Li_off, g.wb * g.wb))
+            bwd_off = lambda g: ((g.U_off, g.wb * g.mb),
+                                 (g.Ui_off, g.wb * g.wb))
+        else:
+            fwd_fn, fwd_src = _fwd_group_T_impl, (U, Ui)
+            bwd_fn, bwd_src = _bwd_group_T_impl, (L, Li)
+            fwd_off = lambda g: ((g.U_off, g.wb * g.mb),
+                                 (g.Ui_off, g.wb * g.wb))
+            bwd_off = lambda g: ((g.L_off, g.mb * g.wb),
+                                 (g.Li_off, g.wb * g.wb))
+
+        for g, (ci, si) in zip(dsched.groups, g_idx):
+            (o1, s1), (o2, s2) = fwd_off(g)
+            X = fwd_fn(X, gsl(fwd_src[0], o1, g.n_loc * s1),
+                       gsl(fwd_src[1], o2, g.n_loc * s2), ci, si,
+                       z, z, mb=g.mb, wb=g.wb,
+                       n_pad=ndev * g.n_loc, cplx=cplx)
+        for g, (ci, si) in zip(reversed(dsched.groups),
+                               reversed(g_idx)):
+            (o1, s1), (o2, s2) = bwd_off(g)
+            X = bwd_fn(X, gsl(bwd_src[0], o1, g.n_loc * s1),
+                       gsl(bwd_src[1], o2, g.n_loc * s2), ci, si,
+                       z, z, mb=g.mb, wb=g.wb,
+                       n_pad=ndev * g.n_loc, cplx=cplx)
+        return _dec(X, cplx)[:n]
+
+    mapped = jax.shard_map(
+        _hi_prec(body), mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    jitted = jax.jit(mapped)
+
+    def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
+        r = b.shape[1]
+        pad = (-r) % ndev
+        if pad:
+            b = jnp.concatenate(
+                [b, jnp.zeros((b.shape[0], pad), b.dtype)], axis=1)
+        x = jitted(L_flat, U_flat, Li_flat, Ui_flat, b)
+        return x[:, :r] if pad else x
+
+    solve.jitted = jitted   # exposed for HLO inspection (tests)
+    return solve
+
+
 def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     """Measured collective inventory of the compiled distributed
     factor and solve programs (per-phase counts + bytes from the
@@ -390,10 +490,15 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     scache = getattr(plan, "_dist_solve_fns", None)
     if scache is None:
         scache = plan._dist_solve_fns = {}
-    skey = (dlu.mesh, dlu.dtype.str, dlu.axis, False)
+    _, ndev = _resolve_axis(dlu.mesh, dlu.axis)
+    # measure the solve program dist_solve actually runs at this nrhs
+    sharded_rhs = _rhs_sharded_auto(nrhs, ndev)
+    skey = (dlu.mesh, dlu.dtype.str, dlu.axis, False, sharded_rhs)
     if skey not in scache:
-        scache[skey] = make_dist_solve(plan, dlu.mesh, dtype=dlu.dtype,
-                                       axis=dlu.axis, trans=False)
+        mk = (make_dist_solve_rhs_sharded if sharded_rhs
+              else make_dist_solve)
+        scache[skey] = mk(plan, dlu.mesh, dtype=dlu.dtype,
+                          axis=dlu.axis, trans=False)
     solve = scache[skey]
     # lower with the dtype production traced with: factor consumes
     # plan.scaled_values(a) — f64 for real systems, c128 for complex —
@@ -404,24 +509,51 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     out = {}
     txt = factor.jitted.lower(vals).compile().as_text()
     out["FACT"] = hlo_collective_stats(txt)
-    b = jnp.zeros((dlu.schedule.n, nrhs), dlu.dtype)
-    txt = solve.lower(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
-                      dlu.Ui_flat, b).compile().as_text()
+    if sharded_rhs:
+        # the wrapper pads nrhs to a ndev multiple before its jit
+        pad_r = nrhs + (-nrhs) % ndev
+        b = jnp.zeros((dlu.schedule.n, pad_r), dlu.dtype)
+        lowerable = solve.jitted
+    else:
+        b = jnp.zeros((dlu.schedule.n, nrhs), dlu.dtype)
+        lowerable = solve
+    txt = lowerable.lower(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                          dlu.Ui_flat, b).compile().as_text()
     out["SOLVE"] = hlo_collective_stats(txt)
     return out
 
 
+def _rhs_sharded_auto(nrhs: int, ndev: int) -> bool:
+    """Pick the rhs-sharded sweep when the column slice amortizes the
+    one-time factor gather (nrhs ≥ 2·ndev).  SLU_RHS_SHARDED=1/0
+    forces."""
+    import os
+    v = os.environ.get("SLU_RHS_SHARDED", "auto").strip().lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    return nrhs >= 2 * ndev
+
+
 def dist_solve(dlu: DistLU, b_factor_order, trans: bool = False):
     """Solve against a DistLU.  Compiled solves are cached on the PLAN
-    keyed (mesh, dtype, trans), so SamePattern re-factorizations reuse
-    them across handles."""
+    keyed (mesh, dtype, trans, mode), so SamePattern re-factorizations
+    reuse them across handles.  Many-RHS solves auto-select the
+    rhs-sharded sweep (make_dist_solve_rhs_sharded)."""
     plan = dlu.plan
     cache = getattr(plan, "_dist_solve_fns", None)
     if cache is None:
         cache = plan._dist_solve_fns = {}
-    key = (dlu.mesh, dlu.dtype.str, dlu.axis, trans)
+    nrhs = int(b_factor_order.shape[1]) \
+        if getattr(b_factor_order, "ndim", 1) == 2 else 1
+    _, ndev = _resolve_axis(dlu.mesh, dlu.axis)
+    sharded_rhs = _rhs_sharded_auto(nrhs, ndev)
+    key = (dlu.mesh, dlu.dtype.str, dlu.axis, trans, sharded_rhs)
     if key not in cache:
-        cache[key] = make_dist_solve(plan, dlu.mesh, dtype=dlu.dtype,
-                                     axis=dlu.axis, trans=trans)
+        mk = (make_dist_solve_rhs_sharded if sharded_rhs
+              else make_dist_solve)
+        cache[key] = mk(plan, dlu.mesh, dtype=dlu.dtype,
+                        axis=dlu.axis, trans=trans)
     return cache[key](dlu.L_flat, dlu.U_flat, dlu.Li_flat,
                       dlu.Ui_flat, b_factor_order)
