@@ -28,18 +28,43 @@ def test_trans_real(backend):
     assert _relres(asp.T, xt, b) < 1e-12
 
 
-@pytest.mark.parametrize("backend", ["host", "jax"])
 @pytest.mark.parametrize("trans", [Trans.TRANS, Trans.CONJ])
-def test_trans_complex(backend, trans):
+def test_trans_complex_host(trans):
     a = helmholtz_2d(6)
     asp = a.to_scipy()
     rng = np.random.default_rng(1)
     b = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
-    lu = factorize(a, Options(factor_dtype="complex128"), backend=backend)
+    lu = factorize(a, Options(factor_dtype="complex128"),
+                   backend="host")
     lu.options = lu.effective_options.replace(trans=trans)
     x = solve(lu, b)
     op = asp.T if trans == Trans.TRANS else asp.conj().T
     assert _relres(op, x, b) < 1e-10
+
+
+def test_trans_complex_jax():
+    """Complex TRANS/CONJ on the device backend.  The suite conftest
+    forces an 8-virtual-device client, so even this single-device-path
+    complex program is subject to the documented per-process XLA:CPU
+    compile lottery (batched.py sweep-codec note: this exact test
+    flaked under the round-1 full-suite compile mix, and again in
+    round 4) — contained the standard way, as a double-draw
+    subprocess (lottery_util)."""
+    from lottery_util import run_double_draw
+    run_double_draw(r"""
+from superlu_dist_tpu import Options, Trans, factorize, solve
+from superlu_dist_tpu.utils.testmat import helmholtz_2d
+a = helmholtz_2d(6)
+asp = a.to_scipy()
+rng = np.random.default_rng(1)
+b = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
+lu = factorize(a, Options(factor_dtype="complex128"), backend="jax")
+for trans, op in ((Trans.TRANS, asp.T), (Trans.CONJ, asp.conj().T)):
+    lu.options = lu.effective_options.replace(trans=trans)
+    x = solve(lu, b)
+    r = np.linalg.norm(op @ x - b) / np.linalg.norm(b)
+    assert r < 1e-10, f"{trans}: relres {r:.3e}"
+""")
 
 
 def test_trans_via_gssvx_factored_rung():

@@ -86,6 +86,31 @@ def main():
     x, lu, stats = gssvx(opts, a, b, stats=stats)
     t_numeric = time.perf_counter() - t0
 
+    # the production SamePattern loop: refactor genuinely NEW values
+    # on the existing plan — with the persistent cache warmed this is
+    # dispatch-only (plan once, warm once, refactor forever; the
+    # superlu_defs.h:577-598 reuse ladder at scale).  The values are
+    # perturbed so a rung that silently skipped the numeric refresh
+    # could not reproduce the new system's solution.
+    import dataclasses
+
+    from superlu_dist_tpu.options import Fact
+    rng = np.random.default_rng(7)
+    a2 = dataclasses.replace(
+        a, data=a.data * (1.0 + 0.01 * rng.standard_normal(
+            len(a.data))))
+    x2true = rng.standard_normal(a2.n)
+    b2 = a2.to_scipy() @ x2true
+    stats2 = Stats()
+    t0 = time.perf_counter()
+    x2, _, stats2 = gssvx(
+        opts.replace(fact=Fact.SAME_PATTERN_SAME_ROWPERM), a2, b2,
+        stats=stats2, lu=lu)
+    t_refactor = time.perf_counter() - t0
+    x2 = np.asarray(x2).reshape(x2true.shape)
+    refactor_relerr = float(np.linalg.norm(x2 - x2true)
+                            / np.linalg.norm(x2true))
+
     x = np.asarray(x).reshape(xtrue.shape)
     relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
     asp = a.to_scipy()
@@ -107,6 +132,7 @@ def main():
             "plan": round(t_plan, 2),
             "schedule": round(t_sched, 2),
             "numeric_total": round(t_numeric, 2),
+            "refactor_same_pattern": round(t_refactor, 2),
             "wall_total": round(time.perf_counter() - t_all, 2),
             "phases_ms": {p: round(v * 1e3, 1)
                           for p, v in stats.utime.items() if v > 0},
@@ -118,6 +144,10 @@ def main():
         "escalations": int(stats.escalations),
         "tiny_pivots": int(stats.tiny_pivots),
         "relerr": relerr,
+        "refactor_relerr": refactor_relerr,
+        "refactor_berr": float(stats2.berr),
+        "refactor_escalations": int(stats2.escalations),
+        "refactor_refine_steps": int(stats2.refine_steps),
         "residual": resid,
         "slab": {
             "upd_peak_elems": int(sched.upd_total),
