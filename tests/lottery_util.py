@@ -36,16 +36,20 @@ import jax.numpy as jnp
 
 def run_double_draw(body: str, env_extra: dict | None = None,
                     timeout: int = 1200,
-                    fatal_patterns: tuple = ()) -> None:
-    """Run _PRELUDE + body in up to two fresh subprocesses; raise only
-    if both draws fail.  The body must print nothing on success and
-    raise/assert on failure.
+                    fatal_patterns: tuple = (),
+                    private_cache: bool = False) -> None:
+    """Run _PRELUDE + body in up to three fresh subprocesses (cache
+    wiped before each retry); raise only if every draw fails.  The
+    body must print nothing on success and raise/assert on failure.
 
     `fatal_patterns`: stderr substrings that mean a WITHIN-PROCESS
     failure the lottery cannot explain (e.g. a nondeterminism
     assertion — rerunning the same executable gave different bytes).
-    Those fail immediately without a second draw: retrying would let
-    an intermittent real regression pass with probability 1-p²."""
+    Those fail immediately without another draw: retrying would let
+    an intermittent real regression pass with probability 1-p^k.
+
+    `private_cache`: use an empty per-call compile-cache dir instead
+    of the shared lottery dir (see inline note)."""
     import shutil
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -59,12 +63,35 @@ def run_double_draw(body: str, env_extra: dict | None = None,
     # tests share their own dir (fast when healthy) and the harness
     # wipes it before the retry draw (self-healing when poisoned),
     # without ever endangering the main suite cache.
-    from superlu_dist_tpu.utils.cache import host_cache_dir
-    cache_dir = host_cache_dir(
-        os.path.join(repo, ".jax_cache_lottery"))
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    if private_cache:
+        # full isolation: an EMPTY per-call cache makes every draw
+        # byte-identical to a standalone run.  The shared dir's state
+        # depends on which lottery tests ran before (their winning
+        # draws persist shared small complex programs), and a
+        # poisoned shared entry turns a specific later test's draws
+        # systematically losing — observed on the round-4 rhs-sharded
+        # complex test: failed in every full-suite run, passed every
+        # standalone run.
+        import tempfile
+        cache_dir = tempfile.mkdtemp(prefix="slu_lottery_")
+        env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    else:
+        from superlu_dist_tpu.utils.cache import host_cache_dir
+        cache_dir = host_cache_dir(
+            os.path.join(repo, ".jax_cache_lottery"))
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     env.update(env_extra or {})
     errs = []
+    try:
+        _draws(body, env, cache_dir, timeout, fatal_patterns, errs)
+    finally:
+        if private_cache:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _draws(body, env, cache_dir, timeout, fatal_patterns, errs):
+    import shutil
+
     for attempt in range(3):
         p = subprocess.run([sys.executable, "-c", _PRELUDE + body],
                            env=env, capture_output=True, text=True,

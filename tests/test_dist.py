@@ -186,9 +186,12 @@ def test_dist_solve_rhs_sharded_complex():
     """Complex systems through the rhs-sharded sweep: the (2, N)
     real-view slab storage and per-shard real/imag encoding must
     reproduce the replicated-X complex solve.  Complex + forced
-    multi-device client => lottery containment subprocess."""
+    multi-device client => lottery containment subprocess, with a
+    PRIVATE compile cache: under the full-suite shared-cache state
+    this test's draws lost systematically while every standalone run
+    passed (lottery_util private_cache note)."""
     from lottery_util import run_double_draw
-    run_double_draw(r"""
+    run_double_draw(private_cache=True, body=r"""
 from superlu_dist_tpu import Options, csr_from_scipy
 from superlu_dist_tpu.parallel.factor_dist import (dist_solve,
                                                    make_dist_factor,
