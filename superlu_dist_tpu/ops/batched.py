@@ -1853,8 +1853,43 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     # device's program.
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.factor_dist import (_regroup,
+    from ..parallel.factor_dist import (_group_operands, _regroup,
+                                        _shard_vals,
                                         _sharded_factor_operands)
+
+    if not _shard_vals(dtype):
+        # complex: keep the round-3 replicated formulation — the
+        # XLA:CPU multi-device complex lottery is acutely sensitive
+        # to the assembly program's shape and the replicated variant
+        # is the best-measured one (factor_dist._shard_vals note)
+        idx_args = _group_operands(sched, range(7))
+        idx_specs = tuple(P(axis) for _ in idx_args)
+
+        def mapped_body_c(vals, b, *idx_flat):
+            b_r = b.astype(rdt)
+            vals_r = vals.astype(rdt)
+            abs_vals = jnp.abs(vals_r)
+
+            def resid_berr(xv):
+                return _resid_berr_impl(vals_r, abs_vals, b_r, xv)
+
+            return step_body(_scale_impl(vals), resid_berr, b_r,
+                             _regroup(sched, idx_flat, 7))
+
+        mapped_c = jax.shard_map(
+            mapped_body_c, mesh=mesh,
+            in_specs=(P(), P()) + idx_specs,
+            out_specs=(P(), P(), P(), P(), P()),
+            check_vma=False)
+
+        jitted_c = jax.jit(
+            lambda vals, b: mapped_c(vals, b, *idx_args))
+
+        def step_c(vals, b):
+            return jitted_c(vals, b)
+
+        step_c.sel = None
+        return step_c
 
     nnz = len(plan.coo_rows)
     sel, idx_args = _sharded_factor_operands(plan, sched, 7)

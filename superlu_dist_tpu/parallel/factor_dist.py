@@ -231,6 +231,24 @@ def _sharded_factor_operands(plan, dsched, per):
     return sel, idx_args
 
 
+# Complex systems keep the ROUND-3 replicated-vals program shape and
+# real systems get the sharded input: the XLA:CPU forced-multi-device
+# client's per-process complex miscompile lottery (lottery_util
+# docstring) turned out to be acutely sensitive to the assembly
+# program's shape — measured per-draw clean rates on the coop-complex
+# body: replicated vals 4/5 (the documented ~1-in-5 loss), sharded
+# complex operands 2/5, sharded real/imag-plane operands 0/6.  Every
+# variation re-rolls unknown odds, so the policy is: pin the
+# best-measured shape for complex on this client, shard the real path
+# (which has never drawn a loss) — and let the TPU hardware smoke
+# (tools/tpu_smoke.py c128 check) decide the real-hardware question,
+# where no such pathology exists.
+
+
+def _shard_vals(dtype) -> bool:
+    return np.dtype(dtype).kind != "c"
+
+
 def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                    axis=None):
     """Build the fused distributed factor+solve step:
@@ -243,19 +261,26 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    sel, idx_args = _sharded_factor_operands(plan, dsched, 7)
+    sharded_in = _shard_vals(dtype)
+    if sharded_in:
+        sel, idx_args = _sharded_factor_operands(plan, dsched, 7)
+        vspec = P(axis)
+    else:
+        sel, idx_args = None, _group_operands(dsched, range(7))
+        vspec = P()
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, b, *idx_flat):
         per_group = _regroup(dsched, idx_flat, 7)
-        flats = _factor_loop(dsched, vals[0], thresh_np, dtype,
-                             per_group, axis)[:4]
+        flats = _factor_loop(dsched,
+                             vals[0] if sharded_in else vals,
+                             thresh_np, dtype, per_group, axis)[:4]
         solve_idx = [(t[5], t[6]) for t in per_group]
         return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
                            trans=False)
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axis), P()) + idx_specs,
+        body, mesh=mesh, in_specs=(vspec, P()) + idx_specs,
         out_specs=P(), check_vma=False)
 
     jitted = jax.jit(lambda vsel, b: mapped(vsel, b, *idx_args))
@@ -264,9 +289,12 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     def step(vals, b):
         # host-side one-time redistribution (dReDistribute_A analog):
         # each device's jit operand is its own value slice, committed
-        # to its shard — never the whole array
-        return jitted(jax.device_put(np.asarray(vals)[sel], vshard),
-                      b)
+        # to its shard — never the whole array.  Complex keeps the
+        # replicated round-3 shape (_shard_vals note).
+        if sharded_in:
+            return jitted(
+                jax.device_put(np.asarray(vals)[sel], vshard), b)
+        return jitted(jnp.asarray(vals), b)
 
     step.jitted = jitted
     step.sel = sel
@@ -305,18 +333,25 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     dtype = np.dtype(dtype)
     thresh_np = _thresh_for(plan, dtype)
 
-    sel, idx_args = _sharded_factor_operands(plan, dsched, 5)
+    sharded_in = _shard_vals(dtype)
+    if sharded_in:
+        sel, idx_args = _sharded_factor_operands(plan, dsched, 5)
+        vspec = P(axis)
+    else:
+        sel, idx_args = None, _group_operands(dsched, range(5))
+        vspec = P()
     idx_specs = tuple(P(axis) for _ in idx_args)
 
     def body(vals, *idx_flat):
         per_group = _regroup(dsched, idx_flat, 5)
         L, U, Li, Ui, tiny, nzero = _factor_loop(
-            dsched, vals[0], thresh_np, dtype, per_group, axis)
+            dsched, vals[0] if sharded_in else vals, thresh_np,
+            dtype, per_group, axis)
         return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
                 jax.lax.psum(nzero, axis))
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axis),) + idx_specs,
+        body, mesh=mesh, in_specs=(vspec,) + idx_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
     jitted = jax.jit(lambda vsel: mapped(vsel, *idx_args))
@@ -325,9 +360,11 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     def factor(vals) -> DistLU:
         # host-side one-time redistribution (dReDistribute_A analog,
         # pddistribute.c:66): ship each device ONLY its slice,
-        # committed to its shard
-        L, U, Li, Ui, tiny, nzero = jitted(
-            jax.device_put(np.asarray(vals)[sel], vshard))
+        # committed to its shard.  Complex keeps the replicated
+        # round-3 shape (_shard_vals note).
+        vv = (jax.device_put(np.asarray(vals)[sel], vshard)
+              if sharded_in else jnp.asarray(vals))
+        L, U, Li, Ui, tiny, nzero = jitted(vv)
         if int(nzero) > 0:
             raise ZeroDivisionError(
                 f"{int(nzero)} exactly-zero pivot(s); matrix singular")
@@ -504,8 +541,10 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     # plan.scaled_values(a) — f64 for real systems, c128 for complex —
     # NOT the factor dtype (the cast happens inside the program); a
     # mismatched aval here would force a pointless full recompile
-    vdt = np.complex128 if dlu.dtype.kind == "c" else np.float64
-    vals = jnp.zeros(factor.sel.shape, vdt)   # per-device slices
+    if factor.sel is None:      # complex: replicated round-3 shape
+        vals = jnp.zeros(len(plan.coo_rows), np.complex128)
+    else:
+        vals = jnp.zeros(factor.sel.shape, np.float64)
     out = {}
     txt = factor.jitted.lower(vals).compile().as_text()
     out["FACT"] = hlo_collective_stats(txt)

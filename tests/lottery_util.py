@@ -8,17 +8,16 @@ time, poisoning every test that reuses the executable in that
 process.  Real dtypes and the single-device client are unaffected,
 and a fresh process re-rolls the draw.
 
-Containment contract: run the test body in a FRESH subprocess; on
-failure, wipe the shared lottery compile cache and retry in another
-fresh process, up to three draws.  A genuine regression fails every
-draw (deterministic code bug).  Three draws, not two: the lottery
-tests SHARE a persistent cache dir, so a loss persisted by an
-EARLIER lottery test makes the first draw sticky-fail (observed
-twice in round-4 full-suite runs: both draws lost, standalone rerun
-with a fresh cache passed) — after the first wipe, draws are
-independent at the empirical ≲1-in-5 per process, putting false
-failures at the percent level without masking real bugs (which keep
-failing all three)."""
+Containment contract: run the test body in a FRESH subprocess with a
+PRIVATE (empty) compile cache per call; on failure, retry up to four
+draws.  A genuine regression fails every draw (deterministic code
+bug; it also reproduces standalone, which a lottery loss does not).
+Four draws because the per-draw loss rate is program-shape- and
+machine-state-dependent: round-4 measurements on the coop-complex
+body ranged from 1-in-5 to 1-in-2 clean-process losses (always the
+same wrong bytes per losing draw — the stable-wrong-compile
+signature), so p⁴ keeps false failures at the percent level without
+masking real bugs (which keep failing all four)."""
 
 import os
 import subprocess
@@ -37,8 +36,8 @@ import jax.numpy as jnp
 def run_double_draw(body: str, env_extra: dict | None = None,
                     timeout: int = 1200,
                     fatal_patterns: tuple = (),
-                    private_cache: bool = False) -> None:
-    """Run _PRELUDE + body in up to three fresh subprocesses (cache
+                    private_cache: bool = True) -> None:
+    """Run _PRELUDE + body in up to four fresh subprocesses (cache
     wiped before each retry); raise only if every draw fails.  The
     body must print nothing on success and raise/assert on failure.
 
@@ -48,8 +47,12 @@ def run_double_draw(body: str, env_extra: dict | None = None,
     Those fail immediately without another draw: retrying would let
     an intermittent real regression pass with probability 1-p^k.
 
-    `private_cache`: use an empty per-call compile-cache dir instead
-    of the shared lottery dir (see inline note)."""
+    `private_cache` (default True): use an empty per-call
+    compile-cache dir, making every draw byte-identical to a
+    standalone run (see inline note).  False shares a cross-test
+    lottery dir — faster when healthy, but its state depends on test
+    order and a persisted shared entry was observed to sink a
+    specific later test's draws systematically."""
     import shutil
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -92,7 +95,7 @@ def run_double_draw(body: str, env_extra: dict | None = None,
 def _draws(body, env, cache_dir, timeout, fatal_patterns, errs):
     import shutil
 
-    for attempt in range(3):
+    for attempt in range(4):
         p = subprocess.run([sys.executable, "-c", _PRELUDE + body],
                            env=env, capture_output=True, text=True,
                            timeout=timeout)
@@ -103,16 +106,16 @@ def _draws(body, env, cache_dir, timeout, fatal_patterns, errs):
             raise AssertionError(
                 "within-process failure (not a compile-lottery draw):"
                 "\n" + errs[-1])
-        if attempt < 2:
+        if attempt < 3:
             # leave a trail: a real intermittent regression that loses
             # only sometimes would otherwise vanish into the retry
-            # (p → p³ silently).  pytest shows this with -rs/-s or on
+            # (p → p⁴ silently).  pytest shows this with -rs/-s or on
             # any later failure; CI logs always capture it.
             print(f"lottery_util: draw {attempt + 1} FAILED, retrying "
                   "with a fresh compile cache; stderr tail:\n"
                   + errs[-1], file=sys.stderr)
             shutil.rmtree(cache_dir, ignore_errors=True)
     raise AssertionError(
-        "failed in three independent processes, two with a fresh "
+        "failed in four independent processes, each with a fresh "
         "compile cache (not a compile-lottery draw — a real "
         "regression):\n" + "\n---\n".join(errs))

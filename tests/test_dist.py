@@ -223,6 +223,37 @@ assert np.allclose(xs, xtrue, atol=1e-8), \
 """)
 
 
+def test_fused_mesh_complex():
+    """The complex fused-mesh branch (replicated round-3 program
+    shape, batched.make_fused_solver _shard_vals gate) end to end.
+    Its own lottery draw — compounding it into another complex test's
+    draws would multiply per-draw loss odds and misattribute
+    failures."""
+    from lottery_util import run_double_draw
+    run_double_draw(r"""
+from superlu_dist_tpu import Options, csr_from_scipy
+from superlu_dist_tpu.ops.batched import make_fused_solver
+from superlu_dist_tpu.plan.plan import plan_factorization
+from jax.sharding import Mesh
+t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(12, 12))
+A = sp.kronsum(t, t, format="csr")
+A = (A + 1j * sp.diags(np.linspace(0.1, 0.4, A.shape[0]))).tocsr()
+a = csr_from_scipy(A)
+rng = np.random.default_rng(5)
+xtrue = rng.standard_normal((a.n, 2)) + 1j * rng.standard_normal((a.n, 2))
+b = A @ xtrue
+plan = plan_factorization(a, Options(factor_dtype="complex128"))
+mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("z",))
+step = make_fused_solver(plan, dtype=np.complex128, mesh=mesh)
+assert step.sel is None      # complex keeps the replicated inputs
+xf, berr, steps, tiny, nzero = step(jnp.asarray(a.data),
+                                    jnp.asarray(b))
+relerr = float(np.linalg.norm(np.asarray(xf) - xtrue)
+               / np.linalg.norm(xtrue))
+assert relerr < 1e-8, f"fused-mesh complex relerr {relerr:.3e}"
+""")
+
+
 def test_dist_unsymmetric():
     a = convection_diffusion_2d(10)
     plan = plan_factorization(a, Options())
