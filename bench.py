@@ -201,22 +201,28 @@ def main():
             jax.config.update("jax_platforms", envp)
         except Exception:
             pass
-    try:
-        # persistent compilation cache: repeated bench runs (and the
-        # per-round driver invocation) skip the fused-program compile.
-        # Host-fingerprinted dir: CPU AOT entries from another machine
-        # type misload (wrong code / SIGILL).
-        from superlu_dist_tpu.utils.cache import host_cache_dir
-        jax.config.update("jax_compilation_cache_dir", host_cache_dir(
-            os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), ".jax_cache")))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:
-        pass
     from superlu_dist_tpu.utils.testmat import laplacian_2d, laplacian_3d
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
+    try:
+        # persistent compilation cache: repeated bench runs (and the
+        # per-round driver invocation) skip the fused-program compile.
+        # CPU runs use the host-fingerprinted dir (AOT entries from
+        # another machine type misload: wrong code / SIGILL);
+        # accelerator runs use the stable shared dir — TPU executables
+        # are device-target-keyed and must survive fingerprint drift.
+        # Decided from the RESOLVED device, not env sniffing: a
+        # CPU-only host with JAX_PLATFORMS unset must not leak CPU
+        # AOT objects into the shared accel dir.
+        from superlu_dist_tpu.utils.cache import cache_dir_for
+        jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+            os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), ".jax_cache"),
+            accel=on_accel))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
     peak_tf = _device_peak_tflops(dev) if on_accel else 0.0
 
     # default: 7-point 3D Laplacian (the fill-heavy separator

@@ -1,0 +1,11 @@
+// Standalone CPUID helper — the minimal translation unit behind
+// superlu_dist_tpu/utils/native.py::cpuid_words_fast().  Compiles in
+// well under a second, so the compile-cache fingerprint can include
+// raw CPUID from the very first process of a session instead of
+// silently degrading to the /proc/cpuinfo-only fingerprint until the
+// full host library happens to get built.
+#include "slu_cpuid.h"
+
+extern "C" int64_t slu_cpuid_words(int64_t* out, int64_t nwords) {
+  return slu_cpuid_words_impl(out, nwords);
+}

@@ -1,0 +1,50 @@
+// Raw CPUID capture for the compile-cache host fingerprint
+// (superlu_dist_tpu/utils/cache.py).  /proc/cpuinfo is virtualized
+// and can read identically across different physical hosts while the
+// CPUID the compiler actually sees differs (observed: XLA:CPU AOT
+// artifacts with +prefer-no-scatter tuning loaded onto a host whose
+// CPUID lacks it — wrong code / NaNs / SIGILL).  Hashing the same
+// leaves LLVM's host detection reads closes that hole.
+//
+// Shared by the full host library (csrc/slu_host.cpp) and the tiny
+// standalone helper (csrc/slu_cpuid.cc) that exists so the
+// fingerprint is computable — hence STABLE — even before the big
+// library's first build: the 2026-08-01 live TPU window compiled
+// into a cpuinfo-only-fingerprinted cache dir that no later
+// (post-native-build) run looked at.
+//
+// Fills `out` with up to nwords int64s (4 packed regs per leaf);
+// returns the count written.
+#pragma once
+#include <cstdint>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <cpuid.h>
+static inline int64_t slu_cpuid_words_impl(int64_t* out,
+                                           int64_t nwords) {
+  struct Leaf { unsigned l, s; };
+  static const Leaf leaves[] = {
+      {0, 0}, {1, 0}, {7, 0}, {7, 1}, {0xd, 0}, {0xd, 1},
+      {0x80000000u, 0}, {0x80000001u, 0}, {0x80000008u, 0},
+      // brand string (the microarch name LLVM keys tuning on)
+      {0x80000002u, 0}, {0x80000003u, 0}, {0x80000004u, 0},
+  };
+  int64_t k = 0;
+  for (const auto& lf : leaves) {
+    unsigned a = 0, b = 0, c = 0, d = 0;
+    __get_cpuid_count(lf.l, lf.s, &a, &b, &c, &d);
+    if (lf.l == 1) b &= 0x00ffffffu;  // strip the per-core APIC id
+    if (k + 2 > nwords) break;
+    out[k++] = ((int64_t)a << 32) | b;
+    out[k++] = ((int64_t)c << 32) | d;
+  }
+  return k;
+}
+#else
+static inline int64_t slu_cpuid_words_impl(int64_t* out,
+                                           int64_t nwords) {
+  (void)out;
+  (void)nwords;
+  return 0;  // non-x86: caller falls back to the /proc fingerprint
+}
+#endif

@@ -6,8 +6,17 @@ warning and at worst wrong code (cpu_aot_loader "could lead to
 execution errors such as SIGILL").  Workspaces here migrate between
 machines, so the cache directory name carries a fingerprint of the
 host's CPU flags — each machine type gets its own cache and never
-loads another's objects.  TPU entries are keyed by device target
-already, but the per-host split is harmless there.
+loads another's objects.
+
+Accelerator artifacts are different: a TPU executable is keyed by
+the DEVICE target and does not depend on host-CPU identity, so those
+runs use a stable un-fingerprinted directory (``cache_dir_for``).
+The 2026-08-01 live window showed why the host split is NOT harmless
+for them: the CPU fingerprint includes raw CPUID only when the
+native library is already built, so the same host can compute two
+different fingerprints across a session (pre-/post- first native
+build) and orphan the expensively-compiled TPU programs in a
+directory no later run looks at.
 """
 
 from __future__ import annotations
@@ -30,6 +39,14 @@ def ensure_portable_cpu_isa(flags: str) -> str:
     return flags
 
 
+def cache_dir_for(base: str, accel: bool) -> str:
+    """Compilation-cache directory for a run that has already
+    resolved where it executes: accelerator runs share one stable
+    directory (device-target-keyed entries, host identity
+    irrelevant); CPU runs get the host-fingerprinted one."""
+    return base + "-accel" if accel else host_cache_dir(base)
+
+
 def host_cache_dir(base: str) -> str:
     """`base` extended with a stable fingerprint of this host's CPU.
 
@@ -50,14 +67,13 @@ def host_cache_dir(base: str) -> str:
     parts = []
     try:
         from . import native
-        # never TRIGGER a native build from here (this runs at
-        # conftest/bench startup); use CPUID only when the built
-        # library is already current on disk
-        if native.so_is_current() and native.available():
-            w = native.cpuid_words()
-            if len(w):
-                parts.append("cpuid=" + ",".join(hex(int(x))
-                                                 for x in w))
+        # cpuid_words_fast never triggers the FULL native build (this
+        # runs at conftest/bench startup) — it reuses the big .so when
+        # current, else builds the sub-second single-TU helper, so the
+        # fingerprint is identical across every process of a session
+        w = native.cpuid_words_fast()
+        if len(w):
+            parts.append("cpuid=" + ",".join(hex(int(x)) for x in w))
     except Exception:
         pass
     try:

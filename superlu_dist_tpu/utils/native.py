@@ -38,18 +38,55 @@ def _so_path() -> str:
                         "_slu_host.so")
 
 
-def so_is_current() -> bool:
-    """True when the built .so exists and is at least as new as its
-    source (the single freshness rule; also used by utils/cache.py to
-    decide whether CPUID can be read without triggering a build)."""
-    src = os.path.join(_repo_root(), "csrc", "slu_host.cpp")
-    out = _so_path()
+def _newer_than_sources(out: str, srcs) -> bool:
     try:
-        return os.path.exists(out) and (
-            not os.path.exists(src)
-            or os.path.getmtime(out) >= os.path.getmtime(src))
+        if not os.path.exists(out):
+            return False
+        mt = os.path.getmtime(out)
+        return all(not os.path.exists(s)
+                   or mt >= os.path.getmtime(s) for s in srcs)
     except OSError:
         return False
+
+
+def so_is_current() -> bool:
+    """True when the built .so exists and is at least as new as its
+    sources (the single freshness rule; also used by utils/cache.py to
+    decide whether CPUID can be read without triggering a build)."""
+    csrc = os.path.join(_repo_root(), "csrc")
+    return _newer_than_sources(_so_path(), [
+        os.path.join(csrc, "slu_host.cpp"),
+        os.path.join(csrc, "slu_cpuid.h")])
+
+
+def _compile_so(src: str, out: str, timeout: int = 300) -> bool:
+    """g++ -shared `src` into `out` via a pid-unique tmp file
+    (concurrent builds race); the single build recipe for both the
+    full host library and the standalone CPUID helper."""
+    tmp = f"{out}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
+             "-shared", src, "-o", tmp],
+            check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, out)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _read_cpuid(lib) -> np.ndarray:
+    """Bind and call slu_cpuid_words on `lib` — the single ctypes
+    contract for the CPUID export, shared by both libraries."""
+    lib.slu_cpuid_words.argtypes = [_I64, ctypes.c_int64]
+    lib.slu_cpuid_words.restype = ctypes.c_int64
+    buf = np.zeros(64, dtype=np.int64)
+    k = lib.slu_cpuid_words(buf.ctypes.data_as(_I64), 64)
+    return buf[:k]
 
 
 def _build() -> str | None:
@@ -59,20 +96,7 @@ def _build() -> str | None:
         return None
     if so_is_current():
         return out
-    tmp = f"{out}.{os.getpid()}.tmp"  # unique: concurrent builds race
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
-             "-shared", src, "-o", tmp],
-            check=True, capture_output=True, timeout=300)
-        os.replace(tmp, out)
-        return out
-    except (OSError, subprocess.SubprocessError):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return None
+    return out if _compile_so(src, out) else None
 
 
 def _load():
@@ -223,10 +247,44 @@ def cpuid_words() -> np.ndarray:
     """Raw CPUID leaf dump (x86; empty elsewhere) — the
     virtualization-proof half of the compile-cache host fingerprint
     (utils/cache.py)."""
-    lib = _load()
-    out = np.zeros(64, dtype=np.int64)
-    k = lib.slu_cpuid_words(out.ctypes.data_as(_I64), 64)
-    return out[:k]
+    return _read_cpuid(_load())
+
+
+def _cpuid_so_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_slu_cpuid.so")
+
+
+def cpuid_words_fast() -> np.ndarray:
+    """CPUID without the full host library: reuse the big .so when it
+    is already current, else build the single-TU helper
+    (csrc/slu_cpuid.cc, well under a second) so the compile-cache
+    fingerprint includes CPUID from the session's FIRST process.
+    Without this, pre-/post-first-native-build processes computed
+    different fingerprints on the same host and orphaned each other's
+    persistent-cache entries (observed: the 2026-08-01 TPU window's
+    executables landed in a dir no later run looked at).  Returns an
+    empty array when no helper can be produced (caller falls back to
+    the /proc fingerprint)."""
+    if os.environ.get("SLU_TPU_NO_NATIVE"):
+        # the documented no-native-code opt-out covers the tiny helper
+        # too: no g++ spawns from conftest/bench startup; caller falls
+        # back to the /proc fingerprint
+        return np.zeros(0, dtype=np.int64)
+    if so_is_current() and available():
+        return cpuid_words()
+    csrc = os.path.join(_repo_root(), "csrc")
+    src = os.path.join(csrc, "slu_cpuid.cc")
+    hdr = os.path.join(csrc, "slu_cpuid.h")
+    out = _cpuid_so_path()
+    if not _newer_than_sources(out, [src, hdr]):
+        if not os.path.exists(src) or not _compile_so(src, out,
+                                                      timeout=60):
+            return np.zeros(0, dtype=np.int64)
+    try:
+        return _read_cpuid(ctypes.CDLL(out))
+    except (OSError, AttributeError):
+        return np.zeros(0, dtype=np.int64)
 
 
 def hwpm(n: int, colptr: np.ndarray, rowind: np.ndarray,

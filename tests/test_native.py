@@ -280,3 +280,41 @@ def test_end_to_end_solve_with_native(laplacian_solver_check=None):
     x, lu, stats = gssvx(Options(), a, b, backend="host")
     relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
     assert relerr < 1e-10
+
+
+def test_cpuid_fast_matches_full_library(monkeypatch):
+    """The standalone CPUID helper must report the same words as the
+    full host library — the compile-cache fingerprint has to be
+    IDENTICAL whether or not the big .so was built yet, else the
+    session's first process orphans its persistent-cache entries
+    (the 2026-08-01 TPU-window regression).  so_is_current is forced
+    False so cpuid_words_fast actually takes the standalone-helper
+    branch rather than delegating back to the big library."""
+    full = native.cpuid_words()
+    if len(full) == 0:
+        pytest.skip("non-x86 host: CPUID words empty by design")
+    monkeypatch.setattr(native, "so_is_current", lambda: False)
+    fast = native.cpuid_words_fast()
+    assert len(fast), "standalone helper produced no words"
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(fast))
+
+
+def test_cpuid_fast_honors_no_native_optout(monkeypatch):
+    """SLU_TPU_NO_NATIVE must suppress the helper build entirely —
+    environments opted out of native code get the /proc fingerprint,
+    not a g++ spawn per process."""
+    monkeypatch.setenv("SLU_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "so_is_current", lambda: False)
+    assert len(native.cpuid_words_fast()) == 0
+
+
+def test_cache_dir_stable_and_accel_split(tmp_path):
+    """cache_dir_for: accelerator runs share one stable
+    un-fingerprinted dir; CPU runs get the host-fingerprinted dir,
+    and that fingerprint is deterministic across calls."""
+    from superlu_dist_tpu.utils.cache import cache_dir_for, host_cache_dir
+    base = str(tmp_path / "jc")
+    assert cache_dir_for(base, accel=True) == base + "-accel"
+    cpu_dir = cache_dir_for(base, accel=False)
+    assert cpu_dir == host_cache_dir(base) != base + "-accel"
+    assert host_cache_dir(base) == cpu_dir  # deterministic

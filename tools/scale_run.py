@@ -39,14 +39,19 @@ def main():
     out_path = os.environ.get(
         "SLU_SCALE_OUT", os.path.join(repo, "SCALE_r04.json"))
 
-    from superlu_dist_tpu.utils.cache import (ensure_portable_cpu_isa,
-                                              host_cache_dir)
+    from superlu_dist_tpu.utils.cache import (cache_dir_for,
+                                              ensure_portable_cpu_isa)
     os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
         os.environ.get("XLA_FLAGS", ""))
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      host_cache_dir(os.path.join(repo, ".jax_cache")))
+    # cache dir from the RESOLVED device (bench.py discipline): a
+    # live-window scale run compiles expensive TPU programs that must
+    # land in the stable shared accel dir, not a host-fingerprinted
+    # one only this process looks at
+    jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+        os.path.join(repo, ".jax_cache"),
+        accel=jax.devices()[0].platform != "cpu"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
     from superlu_dist_tpu import Options

@@ -7,68 +7,74 @@ CPU: the complex path (the real-view sweep codec exists for an
 XLA:CPU miscompile — this is the measurement that would justify
 gating it by platform, VERDICT round-1 weak #8), the f32+IR fused
 step, and the Pallas kernel compile.
+
+Isolation: each check runs in its OWN subprocess with a per-check
+timeout (SLU_SMOKE_CHECK_TIMEOUT, default 420 s; the platform probe
+is capped at 120 s, so probe + 3 checks = 1380 s fits inside
+tpu_fire.sh's outer 1500 s).  The first live window
+(2026-08-01) showed why: the c128 fused program wedged on the tunnel
+for >23 min — while the same-shape f32 program took 92 s — and the
+single-process smoke burned its whole budget inside that one check,
+so the Pallas check never ran.  A hung check now costs at most its
+own timeout and still leaves an honest ``ok:false timeout`` record
+for the codec-gating decision.
+
+The parent never initializes JAX (the platform probe is itself a
+subprocess), so it cannot hold the accelerator while children run;
+and every child record carries the ``platform`` it actually executed
+on, so a silent per-child CPU fallback is visible in the artifact
+rather than masquerading as hardware evidence.
 """
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+# registry of checks; each entry is executed via `tpu_smoke.py <name>`
+# in a child process so a wedged device RPC cannot starve later checks
+CHECKS = ("f32_ir_solve", "c128_solve", "pallas_compile")
 
 
-def check(name):
-    def deco(fn):
-        t0 = time.perf_counter()
-        try:
-            out = fn() or {}
-            out.update(ok=True)
-        except Exception as e:
-            out = dict(ok=False, error=repr(e)[:300])
-        out.update(check=name, secs=round(time.perf_counter() - t0, 2))
-        print(json.dumps(out), flush=True)
-    return deco
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
+def _build_matrix():
     import scipy.sparse as sp
+    from superlu_dist_tpu import csr_from_scipy
+    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(24, 24))
+    return csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
+
+
+def run_check(name):
+    import numpy as np
+    import jax.numpy as jnp
     from superlu_dist_tpu import Options, gssvx, csr_from_scipy
 
-    dev = jax.devices()[0]
-    print(json.dumps({"check": "platform", "ok": dev.platform != "cpu",
-                      "device": str(dev)}), flush=True)
-
-    t = sp.diags([-1.0, 2.4, -1.1], [-1, 0, 1], shape=(24, 24))
-    ar = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
-
-    @check("f32_ir_solve")
-    def _():
+    if name == "f32_ir_solve":
+        ar = _build_matrix()
         rng = np.random.default_rng(0)
         xtrue = rng.standard_normal(ar.n)
         x, _, st = gssvx(Options(factor_dtype="float32"), ar,
                          ar.to_scipy() @ xtrue)
-        relerr = float(np.linalg.norm(x - xtrue)
-                       / np.linalg.norm(xtrue))
+        relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
         return dict(relerr=relerr, berr=st.berr,
                     escalations=st.escalations)
 
-    @check("c128_solve")
-    def _():
+    if name == "c128_solve":
         # the complex path end-to-end on hardware (factor storage is
         # complex; sweeps run the real-view codec)
+        import scipy.sparse as sp
+        ar = _build_matrix()
         rng = np.random.default_rng(1)
         az = ar.to_scipy().astype(np.complex128) \
             + 1j * sp.diags(rng.standard_normal(ar.n) * 0.1)
         az = csr_from_scipy(az.tocsr())
         xtrue = rng.standard_normal(az.n) + 1j * rng.standard_normal(az.n)
         x, _, st = gssvx(Options(), az, az.to_scipy() @ xtrue)
-        relerr = float(np.linalg.norm(x - xtrue)
-                       / np.linalg.norm(xtrue))
+        relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
         return dict(relerr=relerr, berr=st.berr)
 
-    @check("pallas_compile")
-    def _():
+    if name == "pallas_compile":
         from superlu_dist_tpu.ops.pallas_lu import partial_lu_batch_pallas
         F = np.random.default_rng(2).standard_normal(
             (2, 64, 64)).astype(np.float32)
@@ -77,6 +83,174 @@ def main():
             jnp.asarray(F), np.float32(1e-30), wb=32, interpret=False)
         return dict(tiny=int(tp))
 
+    raise ValueError(f"unknown check {name!r}")
+
+
+def child_main(name):
+    """Run one named check and print its record (child-process mode)."""
+    t0 = time.perf_counter()
+    try:
+        out = run_check(name) or {}
+        out.update(ok=True)
+    except Exception as e:
+        out = dict(ok=False, error=repr(e)[:300])
+    # stamp the platform the check actually ran on — but only if the
+    # check itself already initialized a backend: a fresh
+    # jax.devices() here would perform device discovery against a
+    # possibly-wedged tunnel and hang until the SIGKILL, replacing
+    # the real error with a generic timeout record.  If the
+    # initialized-backend introspection breaks (private API moved),
+    # stamp "unknown" rather than dropping the key — a missing
+    # platform must stay OBSERVABLE, else a silent all-CPU run reads
+    # as hardware evidence
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge._backends:
+            out["platform"] = \
+                sys.modules["jax"].devices()[0].platform
+        else:
+            out["platform"] = "uninitialized"
+    except Exception:
+        out["platform"] = "unknown"
+    out.update(check=name, secs=round(time.perf_counter() - t0, 2))
+    print(json.dumps(out), flush=True)
+
+
+def _valid_record(line, name):
+    """A child's record line must be JSON naming the check — anything
+    else (a stray runtime print before a hard crash) is not a result."""
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return False
+    return isinstance(rec, dict) and rec.get("check") == name
+
+
+_live_child = None  # the currently-running check child (its own pgid)
+
+
+def _reap_and_exit(signum, frame):
+    """If the fire plan's outer `timeout` kills this parent mid-check,
+    take the child's whole process group down too — an orphaned wedged
+    child would keep holding the accelerator client into the next fire
+    step (the bench sweep)."""
+    if _live_child is not None and _live_child.poll() is None:
+        try:
+            os.killpg(_live_child.pid, signal.SIGKILL)
+        except OSError:
+            pass
+    raise SystemExit(128 + signum)
+
+
+def _run_child(argv, budget):
+    """Run one child in its own process group with a hard timeout.
+
+    Returns (stdout, stderr, rc, timed_out); on timeout the group is
+    SIGKILLed and whatever output it produced so far is returned so
+    the caller can forward the tail to the fire log.
+    """
+    global _live_child
+    p = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    _live_child = p
+    try:
+        out, err = p.communicate(timeout=budget)
+        return out, err, p.returncode, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            # bounded: a pgid-escaped grandchild holding the pipe fds
+            # must not re-create the one-check-burns-the-budget hang.
+            # Accepted tradeoff: if THIS drain also times out, any
+            # record the child printed before wedging is lost and the
+            # check reports a plain timeout — preserving it would mean
+            # an unbounded read against a held pipe
+            out, err = p.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        return out, err, p.returncode, True
+    finally:
+        _live_child = None
+
+
+def _select_record(name, out, err, rc, timed_out, budget, secs):
+    """One policy for turning a child's output into the record line:
+    a valid record is always kept — the measurement happened — but a
+    timeout after it (a teardown wedge) is annotated rather than
+    silently dropped; with no valid record, synthesize an honest
+    ``ok:false`` carrying the failure mode.  Child stderr is forwarded
+    to our stderr (tpu_fire.sh redirects it to the fire log — the only
+    diagnostic a live-window wedge leaves behind)."""
+    if err.strip():
+        print(err.strip()[-2000:], file=sys.stderr, flush=True)
+    lines = [l for l in out.strip().splitlines()
+             if _valid_record(l, name)]
+    if lines:
+        rec = json.loads(lines[-1])
+        if timed_out:
+            rec["teardown_timeout"] = f">{budget}s (killed after record)"
+        elif rc != 0:
+            # record printed, then the process died hard (runtime
+            # teardown crash) — annotate, don't report a clean pass
+            rec["teardown_rc"] = rc
+        return json.dumps(rec)
+    return json.dumps(dict(
+        check=name, ok=False,
+        error=(f"timeout>{budget}s (killed)" if timed_out
+               else f"child rc={rc}: " + err.strip()[-250:]),
+        secs=secs))
+
+
+def main():
+    try:
+        budget = int(os.environ.get("SLU_SMOKE_CHECK_TIMEOUT", "420"))
+    except ValueError:
+        budget = 420
+    me = os.path.abspath(__file__)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _reap_and_exit)
+
+    # platform probe in a subprocess: the parent must never hold the
+    # accelerator client while children try to acquire it.  Short
+    # budget — device discovery either answers in seconds or the
+    # tunnel is wedged; and probe + 3 checks must fit the fire plan's
+    # outer 1500 s (120 + 3*420 = 1380).
+    t0 = time.perf_counter()
+    out, err, rc, timed_out = _run_child(
+        [sys.executable, "-c",
+         "import jax, json; d = jax.devices()[0]; "
+         "print(json.dumps({'check': 'platform', "
+         "'ok': d.platform != 'cpu', 'device': str(d)}))"],
+        min(budget, 120))
+    print(_select_record("platform", out, err, rc, timed_out,
+                         min(budget, 120),
+                         round(time.perf_counter() - t0, 2)), flush=True)
+    if timed_out:
+        # device discovery itself hangs — every check child would hit
+        # the same wall at JAX init and burn 3×budget of a live
+        # window; record the skips and hand the window back
+        for name in CHECKS:
+            print(json.dumps(dict(
+                check=name, ok=False,
+                error="skipped: platform probe timed out "
+                      "(device discovery wedged)")), flush=True)
+        return
+
+    for name in CHECKS:
+        t0 = time.perf_counter()
+        out, err, rc, timed_out = _run_child(
+            [sys.executable, me, name], budget)
+        print(_select_record(name, out, err, rc, timed_out, budget,
+                             round(time.perf_counter() - t0, 2)),
+              flush=True)
+
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1:
+        child_main(sys.argv[1])
+    else:
+        main()
