@@ -9,8 +9,8 @@ gating it by platform, VERDICT round-1 weak #8), the f32+IR fused
 step, and the Pallas kernel compile.
 
 Isolation: each check runs in its OWN subprocess with a per-check
-timeout (SLU_SMOKE_CHECK_TIMEOUT, default 420 s; the platform probe
-is capped at 120 s, so probe + 3 checks = 1380 s fits inside
+timeout (SLU_SMOKE_CHECK_TIMEOUT, default 330 s; the platform probe
+is capped at 120 s, so probe + 4 checks = 1440 s fits inside
 tpu_fire.sh's outer 1500 s).  The first live window
 (2026-08-01) showed why: the c128 fused program wedged on the tunnel
 for >23 min — while the same-shape f32 program took 92 s — and the
@@ -34,8 +34,14 @@ import sys
 import time
 
 # registry of checks; each entry is executed via `tpu_smoke.py <name>`
-# in a child process so a wedged device RPC cannot starve later checks
-CHECKS = ("f32_ir_solve", "c128_solve", "pallas_compile")
+# in a child process so a wedged device RPC cannot starve later
+# checks.  c128_kernel runs BEFORE c128_solve to bisect the complex
+# wedge observed in the 2026-08-01 window: if the tiny kernel program
+# also hangs, complex lowering on this platform is broken at the base
+# level; if only the full solve hangs, the fault is in the big fused
+# program (compile scaling or a specific fusion).
+CHECKS = ("f32_ir_solve", "c128_kernel", "c128_solve",
+          "pallas_compile")
 
 
 def _build_matrix():
@@ -59,6 +65,26 @@ def run_check(name):
         relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
         return dict(relerr=relerr, berr=st.berr,
                     escalations=st.escalations)
+
+    if name == "c128_kernel":
+        # minimal complex program: one jitted dense partial-LU front
+        # + complex GEMM — the factor path's core ops without the
+        # fused pipeline around them
+        import jax
+        from superlu_dist_tpu.ops.dense_lu import partial_lu
+        rng = np.random.default_rng(3)
+        F = (rng.standard_normal((48, 48))
+             + 1j * rng.standard_normal((48, 48)))
+        F += np.diag(np.full(48, 16.0 + 0j))
+        Fd = jnp.asarray(F, dtype=jnp.complex128)
+        Fp, tiny, nzero = jax.jit(
+            lambda m: partial_lu(m, 1e-30, wb=24))(Fd)
+        Fp.block_until_ready()
+        g = jax.jit(lambda a, b: a @ b)(Fd, Fd)
+        g.block_until_ready()
+        # quick soundness: LU of the leading block reproduces it
+        return dict(finite=bool(np.all(np.isfinite(np.asarray(Fp)))),
+                    gemm_finite=bool(np.all(np.isfinite(np.asarray(g)))))
 
     if name == "c128_solve":
         # the complex path end-to-end on hardware (factor storage is
@@ -89,6 +115,22 @@ def run_check(name):
 def child_main(name):
     """Run one named check and print its record (child-process mode)."""
     t0 = time.perf_counter()
+    try:
+        # persistent compile cache, same discipline as bench.py: a
+        # live window must not re-pay every check's compile, and the
+        # c128 bisect needs warm-vs-cold comparability across windows.
+        # Device discovery here is safe: children only run after the
+        # parent's platform probe answered, and the per-check timeout
+        # bounds a hang either way.
+        import jax
+        from superlu_dist_tpu.utils.cache import cache_dir_for
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+            os.path.join(repo, ".jax_cache"),
+            accel=jax.devices()[0].platform != "cpu"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
     try:
         out = run_check(name) or {}
         out.update(ok=True)
@@ -207,9 +249,9 @@ def _select_record(name, out, err, rc, timed_out, budget, secs):
 
 def main():
     try:
-        budget = int(os.environ.get("SLU_SMOKE_CHECK_TIMEOUT", "420"))
+        budget = int(os.environ.get("SLU_SMOKE_CHECK_TIMEOUT", "330"))
     except ValueError:
-        budget = 420
+        budget = 330
     me = os.path.abspath(__file__)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _reap_and_exit)
@@ -217,8 +259,8 @@ def main():
     # platform probe in a subprocess: the parent must never hold the
     # accelerator client while children try to acquire it.  Short
     # budget — device discovery either answers in seconds or the
-    # tunnel is wedged; and probe + 3 checks must fit the fire plan's
-    # outer 1500 s (120 + 3*420 = 1380).
+    # tunnel is wedged; and probe + 4 checks must fit the fire plan's
+    # outer 1500 s (120 + 4*330 = 1440).
     t0 = time.perf_counter()
     out, err, rc, timed_out = _run_child(
         [sys.executable, "-c",

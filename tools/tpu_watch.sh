@@ -1,0 +1,36 @@
+#!/bin/bash
+# Tunnel watcher: probe the accelerator every SLU_WATCH_PERIOD (150 s)
+# and launch tools/tpu_fire.sh the moment device discovery answers.
+# The tunnel on this host dies for hours and resurfaces briefly — an
+# unattended watcher is the only way a short window gets exploited.
+#
+#   nohup tools/tpu_watch.sh >> .tpu_watch.log 2>&1 &
+#
+# One fire at a time: the watcher skips the probe while a fire (or a
+# driver bench) is still running, and after a completed fire it keeps
+# watching — a later window re-fires, which is cheap now that the
+# expensive programs sit in the shared .jax_cache-accel dir.
+set -u
+repo=$(cd "$(dirname "$0")/.." && pwd)
+# the accelerator plugin loads via /root/.axon_site; a bare PYTHONPATH
+# (fresh login shell, cron, post-reboot) would make every probe see
+# CPU only and the watcher would silently never fire
+export PYTHONPATH=$repo:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}
+period=${SLU_WATCH_PERIOD:-150}
+probe_timeout=${SLU_WATCH_PROBE_TIMEOUT:-90}
+stamp() { echo "[watch $(date +%H:%M:%S)] $*"; }
+stamp "armed (period=${period}s probe_timeout=${probe_timeout}s)"
+while :; do
+  if pgrep -f "tools/tpu_fire.sh" >/dev/null 2>&1 \
+     || pgrep -f "$repo/bench.py" >/dev/null 2>&1; then
+    sleep "$period"; continue
+  fi
+  if timeout "$probe_timeout" python -c \
+      "import jax; assert jax.devices()[0].platform != 'cpu'" \
+      >/dev/null 2>&1; then
+    stamp "tunnel LIVE -> firing"
+    bash "$repo/tools/tpu_fire.sh"
+    stamp "fire sequence returned"
+  fi
+  sleep "$period"
+done
