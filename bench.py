@@ -29,13 +29,17 @@ SLU_BENCH_K overrides the grid edge; SLU_BENCH_NRHS covers the
 many-RHS solve regime (ldoor nrhs=64 baseline config #5).
 
 SLU_BENCH_SWEEP=1 additionally runs the secondary baseline configs
-(nrhs=64 solve regime; ≥200k-dof 3D problem) and appends one JSON
-object per config to BENCH_SWEEP.jsonl next to this file — telemetry
-for the judge; the stdout contract stays one line.
+(nrhs=64 solve regime; n=110k and n=262k 3D problems) and appends one
+JSON object per config to BENCH_SWEEP.jsonl next to this file —
+telemetry for the judge; the stdout contract stays one line.  Each
+sweep config runs in its own subprocess under
+SLU_SWEEP_CONFIG_TIMEOUT (2400 s) so one wedged compile or a mid-run
+tunnel death cannot eat the rest of a live hardware window.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -284,29 +288,133 @@ def main():
     }))
     sys.stdout.flush()
 
+    if os.environ.get("SLU_BENCH_EMIT_RECORD") == "1":
+        # sweep-child mode: the parent wants the raw record dict as an
+        # additional machine-readable line (the contract line above
+        # already printed).  The record carries THIS process's resolved
+        # platform/fallback state: after a mid-run accelerator death
+        # the re-exec'd CPU child must not have its numbers stamped
+        # with the parent's accelerator identity.
+        print(json.dumps(dict(
+            r, record=True, platform=dev.platform,
+            device_kind=getattr(dev, "device_kind", ""),
+            cpu_fallback=cpu_fallback)))
+        sys.stdout.flush()
+
     if os.environ.get("SLU_BENCH_SWEEP") == "1":
         # secondary configs run AFTER the primary stdout line is out —
-        # a sweep hang/OOM must not cost the contract line — and each
-        # record is appended as soon as it exists
+        # a sweep hang/OOM must not cost the contract line.  Each
+        # config runs in its OWN subprocess with a timeout: the
+        # 2026-08-01 live window died with the in-process sweep wedged
+        # on a re-dead tunnel, and the n=262k fused compile is big
+        # enough to eat a whole window by itself.  Records append as
+        # each config lands, so a dying window keeps the completed
+        # ones.  Config order is value-per-minute: many-RHS (cheap,
+        # reuses the primary's matrix scale), then n=110k, then the
+        # n=262k flagship.
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_SWEEP.jsonl")
+        # default keeps 3 children + the warm primary inside
+        # tpu_fire.sh's outer `timeout 5400`
+        budget = int(os.environ.get("SLU_SWEEP_CONFIG_TIMEOUT", "1500"))
 
         def emit(rec):
-            rec = dict(rec, platform=dev.platform,
-                       device_kind=getattr(dev, "device_kind", ""),
-                       cpu_fallback=cpu_fallback,
-                       ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+            # defaults first: a child-provided platform/fallback (the
+            # re-exec'd-on-CPU case) must survive the merge
+            merged = dict(platform=dev.platform,
+                          device_kind=getattr(dev, "device_kind", ""),
+                          cpu_fallback=cpu_fallback)
+            merged.update(rec)
+            merged["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
             with open(path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+                f.write(json.dumps(merged) + "\n")
+
+        def run_config_child(env, timeout_s):
+            """One sweep config in its own process group; on timeout
+            the whole group is killed (an orphaned child would keep
+            holding the accelerator).  Returns (record|None, rc,
+            stderr, timed_out)."""
+            import signal
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, start_new_session=True)
+            try:
+                out, err = p.communicate(timeout=timeout_s)
+                timed_out = False
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                try:
+                    out, err = p.communicate(timeout=15)
+                except subprocess.TimeoutExpired:
+                    out, err = "", ""
+                timed_out = True
+            rec = None
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict) and cand.get("record"):
+                    cand.pop("record", None)
+                    rec = cand
+                    break
+            return rec, p.returncode, err, timed_out
+
+        def tunnel_alive():
+            try:
+                subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; jax.devices()"],
+                    timeout=90, check=True, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
+                return True
+            except Exception:
+                return False
 
         emit(r)
-        extras = [(lambda: laplacian_3d(64), "3D Laplacian n=262144", 1)]
+        # (k, nrhs, shape): the scale configs are always the 3D
+        # family (SLU_BENCH_SWEEP_KS overrides the ladder); the
+        # many-RHS config reuses the primary's shape
+        extras = [(k2.strip(), "1", "3d") for k2 in os.environ.get(
+            "SLU_BENCH_SWEEP_KS", "48,64").split(",") if k2.strip()]
         if nrhs != 64:  # skip if the primary already covered nrhs=64
-            extras.insert(0, (lambda: a, desc, 64))  # many-RHS regime
-        for mk2, d2, nr2 in extras:
-            try:  # matrix construction inside: an OOM building the
-                  # extra is a sweep record, not a process failure
-                emit(_run_config(mk2(), d2, nr2, jnp))
+            extras.insert(0, (str(k), "64", shape))  # many-RHS regime
+        aborted = False
+        for k2, nr2, shp2 in extras:
+            d2 = f"sweep config k={k2} nrhs={nr2} shape={shp2}"
+            if aborted:
+                emit(dict(desc=d2, error="skipped: tunnel died "
+                                         "earlier in the sweep"))
+                continue
+            try:
+                n2 = int(k2) ** 3 if shp2 == "3d" else int(k2) ** 2
+                d2 = (f"{'3D' if shp2 == '3d' else '2D'} Laplacian "
+                      f"n={n2}") + (f" nrhs={nr2}" if nr2 != "1"
+                                    else "")
+                env = dict(os.environ, SLU_BENCH_K=k2,
+                           SLU_BENCH_NRHS=nr2, SLU_BENCH_SHAPE=shp2,
+                           SLU_BENCH_EMIT_RECORD="1",
+                           SLU_BENCH_ASSUME_LIVE="1")
+                env.pop("SLU_BENCH_SWEEP", None)
+                rec, rc, err, timed_out = run_config_child(env, budget)
+                if rec:
+                    emit(rec)
+                elif timed_out:
+                    emit(dict(desc=d2,
+                              error=f"timeout>{budget}s (killed)"))
+                else:
+                    emit(dict(desc=d2,
+                              error=f"child rc={rc}: "
+                                    + err.strip()[-250:]))
+                if (rec is None and on_accel
+                        and not tunnel_alive()):
+                    # dead tunnel: every remaining accelerator config
+                    # would burn its full budget the same way
+                    aborted = True
             except Exception as e:
                 emit(dict(desc=d2, error=repr(e)))
 
