@@ -60,7 +60,20 @@ from .options import (  # noqa: E402
 from .utils.stats import Stats  # noqa: E402
 from .sparse import CSRMatrix, csr_from_coo, csr_from_scipy  # noqa: E402
 from .plan.plan import FactorPlan, plan_factorization  # noqa: E402
-from .models.gssvx import LUFactorization, factorize, gssvx, solve  # noqa: E402
+from .models.gssvx import (  # noqa: E402
+    LUFactorization,
+    factorize,
+    get_diag_u,
+    gssvx,
+    query_space,
+    solve,
+)
+from .parallel.grid import make_solver_mesh  # noqa: E402
+from .parallel.multihost import (  # noqa: E402
+    csr_from_row_slices,
+    plan_factorization_multihost,
+)
+from .utils.io import read_matrix  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -76,11 +89,17 @@ __all__ = [
     "CSRMatrix",
     "csr_from_coo",
     "csr_from_scipy",
+    "csr_from_row_slices",
     "FactorPlan",
     "plan_factorization",
+    "plan_factorization_multihost",
     "LUFactorization",
     "factorize",
+    "get_diag_u",
     "gssvx",
+    "make_solver_mesh",
+    "query_space",
+    "read_matrix",
     "solve",
     "__version__",
 ]

@@ -9,7 +9,7 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = ("README.md", "DESIGN.md", "PARITY.md", "ROUND2.md",
-        "ROUND4.md")
+        "ROUND4.md", "MIGRATION.md")
 _PAT = re.compile(
     r"\b((?:tests|tools|csrc|superlu_dist_tpu)/[\w/.]+\.(?:py|f90|cpp|c|so|md))")
 
