@@ -104,6 +104,24 @@ def _ensure_live_backend():
     return True, reason
 
 
+def _last_hw_note() -> str:
+    """On a CPU fallback, point at the most recent committed on-TPU
+    measurement (TPU_BENCH_LIVE.json, written by tools/tpu_fire.sh in
+    a live tunnel window) so the fallback line still references the
+    hardware evidence instead of silently replacing it."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TPU_BENCH_LIVE.json")
+        with open(path) as f:
+            rec = json.load(f)
+        if rec.get("cpu_fallback") or "value" not in rec:
+            return ""
+        return (f"; last hardware measurement: {rec['value']} "
+                f"{rec.get('unit', '')} (TPU_BENCH_LIVE.json)")
+    except Exception:
+        return ""
+
+
 def _device_peak_tflops(dev) -> float:
     kind = getattr(dev, "device_kind", "").lower()
     for k, v in _PEAK_TFLOPS.items():
@@ -278,7 +296,8 @@ def main():
                   + mfu_txt
                   + ("" if r["accuracy_ok"] else "; ACCURACY CHECK FAILED")
                   + (f"; CPU FALLBACK (accelerator unreachable: "
-                     f"{fb_reason})" if cpu_fallback else "")
+                     f"{fb_reason})" + _last_hw_note()
+                     if cpu_fallback else "")
                   + ")",
         "value": round(r["gflops"], 3) if r["accuracy_ok"] else 0.0,
         "unit": "GFLOP/s",

@@ -39,12 +39,23 @@ stamp "fire start (dryrun=${SLU_FIRE_DRYRUN:-0})"
 
 # 1. BENCH, primary config only — the <5-min-budget artifact.  The
 #    watcher just probed, so skip bench's own probe ladder; staged
-#    dispatch stays off (200 ms tunnel RPC x groups).
+#    dispatch stays off (200 ms tunnel RPC x groups).  Write to a temp
+#    file and promote only a real on-hardware record: `> $bench_out`
+#    would truncate the committed hardware evidence BEFORE bench runs,
+#    so a tunnel that died between probe and bench would replace the
+#    prior TPU measurement with a CPU-fallback line.
+bench_tmp=$(mktemp)
 SLU_BENCH_ASSUME_LIVE=1 timeout 1500 python "$repo/bench.py" \
-  > "$bench_out" 2>> "$log"
+  > "$bench_tmp" 2>> "$log"
 rc=$?
-stamp "bench primary rc=$rc -> $bench_out"
-cat "$bench_out" >> "$log"
+cat "$bench_tmp" >> "$log"
+if grep -q '"cpu_fallback": false' "$bench_tmp"; then
+  mv "$bench_tmp" "$bench_out"
+  stamp "bench primary rc=$rc -> $bench_out"
+else
+  rm -f "$bench_tmp"
+  stamp "bench primary rc=$rc fell back/failed; kept prior $bench_out"
+fi
 
 # 2. Hardware smoke — the complex-path cleanliness measurement that
 #    decides the real-view codec gate (TPU_SMOKE.jsonl), Pallas compile.

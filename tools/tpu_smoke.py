@@ -116,6 +116,15 @@ def child_main(name):
     """Run one named check and print its record (child-process mode)."""
     t0 = time.perf_counter()
     try:
+        # XLA:CPU portability cap BEFORE jax import (bench.py/conftest
+        # discipline): uncapped CPU compiles embed host-model tuning
+        # flags (+prefer-no-gather/-scatter) in persistent-cache
+        # entries, the misload class the ISA cap exists to prevent —
+        # observed again 2026-08-01 from exactly this entry point.
+        # No effect on accelerator execution.
+        from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
         # persistent compile cache, same discipline as bench.py: a
         # live window must not re-pay every check's compile, and the
         # c128 bisect needs warm-vs-cold comparability across windows.
