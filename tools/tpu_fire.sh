@@ -58,8 +58,12 @@ else
 fi
 
 # 2. Hardware smoke — the complex-path cleanliness measurement that
-#    decides the real-view codec gate (TPU_SMOKE.jsonl), Pallas compile.
-timeout 1500 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
+#    decides the real-view codec gate (TPU_SMOKE.jsonl), Pallas
+#    compile.  240 s per check: generous for the measured ~92 s
+#    compile class, and a repeat of the known c128 wedge costs 4 min
+#    of the window, not the full default budget.
+SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
+  timeout 1500 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
 stamp "smoke rc=$? -> $smoke_out"
 
 # 3+4 run on hardware only: the sweep's n=262k config uses the fused
