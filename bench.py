@@ -331,8 +331,11 @@ def main():
         # ones.  Config order is value-per-minute: many-RHS (cheap,
         # reuses the primary's matrix scale), then n=110k, then the
         # n=262k flagship.
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_SWEEP.jsonl")
+        # SLU_BENCH_SWEEP_PATH override exists so tests can aim the
+        # records at a scratch file instead of the tracked telemetry
+        path = os.environ.get("SLU_BENCH_SWEEP_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SWEEP.jsonl")
         # default keeps 3 children + the warm primary inside
         # tpu_fire.sh's outer `timeout 5400`
         budget = int(os.environ.get("SLU_SWEEP_CONFIG_TIMEOUT", "1500"))

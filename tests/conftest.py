@@ -47,13 +47,20 @@ def pytest_configure(config):
         "scale: target-scale end-to-end runs (≥10⁵ dof, ~30+ min on "
         "a 1-core host) — excluded from the default suite; run with "
         "`pytest -m scale`")
+    config.addinivalue_line(
+        "markers",
+        "sweep: bench-sweep plumbing runs (spawn real bench "
+        "subprocesses, ~5 min) — excluded from the default suite; "
+        "run with `pytest -m sweep`")
 
 
 def pytest_collection_modifyitems(config, items):
     import pytest
     if config.getoption("-m"):
         return            # explicit -m selection is honored as given
-    skip = pytest.mark.skip(reason="scale run: opt in with -m scale")
-    for item in items:
-        if "scale" in item.keywords:
-            item.add_marker(skip)
+    for name in ("scale", "sweep"):
+        skip = pytest.mark.skip(reason=f"{name} run: opt in with "
+                                       f"-m {name}")
+        for item in items:
+            if name in item.keywords:
+                item.add_marker(skip)

@@ -1,0 +1,50 @@
+"""Bench-sweep plumbing (bench.py SLU_BENCH_SWEEP): per-config
+subprocess isolation, record promotion, timeout records, and
+malformed-ladder resilience — the machinery a live hardware window
+depends on (tools/tpu_fire.sh step 3).  Opt-in (`pytest -m sweep`):
+each case spawns real bench subprocesses (~minutes on a 1-core host).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.sweep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "bench.py")
+
+
+def test_sweep_records_and_timeout(tmp_path):
+    """A malformed ladder entry becomes an error record without
+    aborting the sweep; a config that cannot finish inside its budget
+    (k=40 in 5 s — the child barely finishes importing jax) lands an
+    honest timeout record; the contract line stays first and
+    parseable.  Records go to a scratch file (SLU_BENCH_SWEEP_PATH),
+    never the tracked telemetry."""
+    sweep_path = tmp_path / "sweep.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SLU_BENCH_SWEEP="1",
+               SLU_BENCH_K="10", SLU_BENCH_NRHS="64",
+               SLU_BENCH_SWEEP_PATH=str(sweep_path),
+               SLU_BENCH_SWEEP_KS="bogus,40",
+               SLU_SWEEP_CONFIG_TIMEOUT="5")
+    p = subprocess.run([sys.executable, BENCH], timeout=900,
+                       capture_output=True, text=True, env=env)
+    assert p.returncode == 0, p.stderr[-500:]
+    out_lines = p.stdout.strip().splitlines()
+    assert out_lines, p.stderr[-500:]
+    line = json.loads(out_lines[0])
+    assert line["unit"] == "GFLOP/s" and line["value"] > 0
+
+    recs = [json.loads(ln) for ln in
+            sweep_path.read_text().strip().splitlines()]
+    # primary record + malformed-K error + timed-out k=40
+    assert len(recs) == 3, recs
+    assert recs[0]["desc"].startswith("3D Laplacian n=1000")
+    assert "invalid literal" in recs[1]["error"]
+    assert recs[2]["error"].startswith("timeout>5s")
+    for r in recs:
+        assert r["platform"] == "cpu" and "ts" in r
