@@ -262,6 +262,11 @@ def main():
         a = laplacian_2d(k)
         desc = f"2D Laplacian n={k * k}"
     nrhs = int(os.environ.get("SLU_BENCH_NRHS", "1"))
+    if os.environ.get("SUPERLU_AMALG_TAU_PCT"):
+        # annotate A/B runs (tools/tpu_fire.sh step 5) so their
+        # records are distinguishable in the sweep telemetry
+        desc += (f" tau={os.environ['SUPERLU_AMALG_TAU_PCT']}%"
+                 f"/cap={os.environ.get('SUPERLU_AMALG_CAP', 'dflt')}")
 
     try:
         r = _run_config(a, desc, nrhs, jnp)
