@@ -73,6 +73,10 @@ from .parallel.multihost import (  # noqa: E402
     csr_from_row_slices,
     plan_factorization_multihost,
 )
+from .parallel.psymbfact_dist import (  # noqa: E402
+    plan_factorization_dist,
+    scaled_values_local,
+)
 from .utils.io import read_matrix  # noqa: E402
 
 __version__ = "0.1.0"
@@ -92,7 +96,9 @@ __all__ = [
     "csr_from_row_slices",
     "FactorPlan",
     "plan_factorization",
+    "plan_factorization_dist",
     "plan_factorization_multihost",
+    "scaled_values_local",
     "LUFactorization",
     "factorize",
     "get_diag_u",

@@ -119,6 +119,26 @@ def deserialize_plan(data: bytes) -> FactorPlan:
     return plan
 
 
+def _frame_ok(payload: bytes) -> bytes:
+    """Success frame for a rank-0-computed broadcast payload."""
+    return b"\x00" + payload
+
+
+def _frame_err(e: Exception) -> bytes:
+    """Failure frame: ship the exception text so EVERY host raises —
+    a one-sided raise would leave the other hosts deadlocked inside
+    the collective."""
+    return b"\x01" + repr(e).encode("utf-8", "replace")
+
+
+def _unframe(blob: bytes, what: str) -> bytes:
+    if blob[:1] == b"\x01":
+        raise RuntimeError(
+            f"{what} failed on process 0: "
+            + blob[1:].decode("utf-8", "replace"))
+    return blob[1:]
+
+
 def _broadcast_bytes(data: bytes | None, is_source: bool) -> bytes:
     """Broadcast a byte string from process 0 to all processes.
     Two-phase (length, then padded payload) because
@@ -139,6 +159,55 @@ def _broadcast_bytes(data: bytes | None, is_source: bool) -> bytes:
     return payload.tobytes()
 
 
+def _assemble_structure(slices, m: int):
+    """Contiguous row blocks -> global pattern.  `slices` is a list of
+    (fst_row, indptr_loc, indices_loc, ...) covering [0, m) exactly
+    once (any order; fields past the third ride along untouched);
+    returns (indptr, indices, ordered) where `ordered` is the
+    validated row-sorted slice list, so value-carrying callers can
+    concatenate their payloads in the same order.  This is the one
+    implementation of the NRformat_loc tiling contract
+    (supermatrix.h:176-188) — structure-only planning
+    (parallel/psymbfact_dist.py) and full-matrix assembly (below)
+    both ride it."""
+    # zero-row slices are legal NRformat_loc participants — drop them
+    # before the tiling check (their fst_row ties are meaningless)
+    slices = [s for s in slices if len(s[1]) > 1]
+    slices = sorted(slices, key=lambda s: s[0])
+    row = 0
+    for fst, ip, ix, *_ in slices:
+        if np.asarray(ip)[0] != 0:
+            raise ValueError(
+                "each slice's indptr must be LOCAL (zero-based); got "
+                f"indptr[0] = {np.asarray(ip)[0]} for the slice at "
+                f"row {fst} — pass the rebased block, not a view of "
+                "the global indptr")
+        if len(ix) != int(np.asarray(ip)[-1]):
+            raise ValueError(
+                f"slice at row {fst}: {len(ix)} indices but indptr "
+                f"accounts for {int(np.asarray(ip)[-1])}")
+        if fst != row:
+            raise ValueError(
+                f"row slices must tile [0, {m}) contiguously: got a "
+                f"slice starting at {fst}, expected {row}")
+        row += len(ip) - 1
+    if row != m:
+        raise ValueError(f"row slices cover {row} rows, matrix has {m}")
+    indptr = np.zeros(m + 1, dtype=np.int64)
+    parts_i = []
+    base = 0
+    r = 0
+    for _, ip, ix, *_rest in slices:
+        ip = np.asarray(ip, dtype=np.int64)
+        indptr[r + 1:r + len(ip)] = base + ip[1:]
+        base += int(ip[-1])
+        r += len(ip) - 1
+        parts_i.append(np.asarray(ix, dtype=np.int64))
+    indices = (np.concatenate(parts_i) if parts_i
+               else np.zeros(0, np.int64))
+    return indptr, indices, slices
+
+
 def _assemble_row_slices(slices, m: int, n: int):
     """Contiguous row blocks -> one global CSRMatrix.  `slices` is a
     list of (fst_row, indptr_loc, indices_loc, data_loc) covering
@@ -148,43 +217,14 @@ def _assemble_row_slices(slices, m: int, n: int):
     paths so the wire code has no layout logic of its own."""
     from ..sparse import CSRMatrix
 
-    # zero-row slices are legal NRformat_loc participants — drop them
-    # before the tiling check (their fst_row ties are meaningless)
-    slices = [s for s in slices if len(s[1]) > 1]
-    slices = sorted(slices, key=lambda s: s[0])
-    row = 0
     for fst, ip, ix, dv in slices:
-        if np.asarray(ip)[0] != 0:
-            raise ValueError(
-                "each slice's indptr must be LOCAL (zero-based); got "
-                f"indptr[0] = {np.asarray(ip)[0]} for the slice at "
-                f"row {fst} — pass the rebased block, not a view of "
-                "the global indptr")
         if len(ix) != len(dv):
             raise ValueError(
                 f"slice at row {fst}: {len(ix)} indices vs "
                 f"{len(dv)} values")
-        if fst != row:
-            raise ValueError(
-                f"row slices must tile [0, {m}) contiguously: got a "
-                f"slice starting at {fst}, expected {row}")
-        row += len(ip) - 1
-    if row != m:
-        raise ValueError(f"row slices cover {row} rows, matrix has {m}")
-    indptr = np.zeros(m + 1, dtype=np.int64)
-    parts_i, parts_d = [], []
-    base = 0
-    r = 0
-    for _, ip, ix, dv in slices:
-        ip = np.asarray(ip, dtype=np.int64)
-        indptr[r + 1:r + len(ip)] = base + ip[1:]
-        base += int(ip[-1])
-        r += len(ip) - 1
-        parts_i.append(np.asarray(ix, dtype=np.int64))
-        parts_d.append(np.asarray(dv))
-    return CSRMatrix(m, n, indptr,
-                     np.concatenate(parts_i) if parts_i else
-                     np.zeros(0, np.int64),
+    indptr, indices, ordered = _assemble_structure(slices, m)
+    parts_d = [np.asarray(dv) for _, _, _, dv in ordered]
+    return CSRMatrix(m, n, indptr, indices,
                      np.concatenate(parts_d) if parts_d else
                      np.zeros(0))
 
@@ -274,14 +314,11 @@ def plan_factorization_multihost(a, options=None, *, stats=None,
         try:
             plan = plan_factorization(a, options, stats=stats,
                                       autotune=autotune)
-            blob = b"\x00" + serialize_plan(plan)
+            blob = _frame_ok(serialize_plan(plan))
         except Exception as e:  # ship the failure, don't deadlock
-            blob = b"\x01" + repr(e).encode("utf-8", "replace")
+            blob = _frame_err(e)
     blob = _broadcast_bytes(blob, is_source)
-    if blob[:1] == b"\x01":
-        raise RuntimeError(
-            "plan_factorization failed on process 0: "
-            + blob[1:].decode("utf-8", "replace"))
+    payload = _unframe(blob, "plan_factorization")
     if is_source:
         return plan
-    return deserialize_plan(blob[1:])
+    return deserialize_plan(payload)

@@ -116,6 +116,30 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
             options.col_perm, user_perm_c,
             nd_threads=options.nd_threads)
 
+    anorm = float(np.max(np.abs(scaled_vals))) if len(scaled_vals) else 1.0
+    return plan_from_perms(n, options, stats, equed, r_eff, c_eff,
+                           perm_r, perm_c, coo_rows, coo_cols, anorm,
+                           autotune=autotune)
+
+
+def plan_from_perms(n: int, options: Options, stats: Stats,
+                    equed: str, r_eff: np.ndarray, c_eff: np.ndarray,
+                    perm_r: np.ndarray, perm_c: np.ndarray,
+                    coo_rows: np.ndarray, coo_cols: np.ndarray,
+                    anorm: float, symbfact_fn=None,
+                    autotune: bool | None = None) -> FactorPlan:
+    """The permutation-independent back half of the pipeline: etree →
+    postorder → symbfact → frontal maps → FactorPlan.  ONE
+    implementation shared by plan_factorization and the distributed
+    plan path (parallel/psymbfact_dist.py) — the bit-identity
+    contract between them holds by construction for every stage here.
+
+    symbfact_fn(b_indptr, b_indices, part) -> SymbolicFactorization
+    lets the distributed path substitute its domain-distributed wave;
+    None = the local (native, optionally threaded) pass."""
+    if autotune is None:
+        autotune = bool(getattr(options, "autotune", False))
+
     # rows/cols after Pr then symmetric Pc
     r1 = perm_c[perm_r[coo_rows]]
     c1 = perm_c[coo_cols]
@@ -151,8 +175,11 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         colcount = col_counts_postordered(b_indptr, b_indices, parent)
         part = find_supernodes(parent, colcount,
                                options.relax, options.max_super)
-        sym = symbolic_factorize(b_indptr, b_indices, part,
-                                 threads=options.symb_threads)
+        if symbfact_fn is None:
+            sym = symbolic_factorize(b_indptr, b_indices, part,
+                                     threads=options.symb_threads)
+        else:
+            sym = symbfact_fn(b_indptr, b_indices, part)
         sym = amalgamate(sym, options.amalg_tau, options.amalg_cap)
 
     # [Dist-plan] frontal maps (the pddistribute analog — here it
@@ -161,8 +188,6 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
         frontal = build_frontal_plan(
             sym, fr, fc,
             options.width_buckets, options.front_buckets)
-
-    anorm = float(np.max(np.abs(scaled_vals))) if len(scaled_vals) else 1.0
 
     plan = FactorPlan(
         n=n, options=options, equed=equed,

@@ -1,0 +1,244 @@
+"""Domain-decomposed supernodal symbolic factorization.
+
+Analog of the reference's parallel symbolic factorization
+(`symbfact_dist`, SRC/psymbfact.c:150): the supernodal etree is cut
+into *domains* — disjoint complete subtrees, each small enough to be
+one process's independent job — plus a *top* set of ancestor
+supernodes (the separator levels).  The reference's three phases map
+directly:
+
+  * `domain_symbfact` (psymbfact.c:424): each domain's struct lists
+    depend ONLY on that domain's columns of B plus child structs that
+    are themselves inside the domain (a complete subtree is closed
+    under children), so domains compute with zero communication and
+    zero visibility of the rest of the pattern.  `domain_symbfact`
+    below enforces that literally: it is handed a column SLICE of B.
+  * `interLvl_/intraLvl_symbfact` (psymbfact.c:440-477): the top set.
+    Each top supernode unions its own B columns with child structs;
+    children are either other top supernodes or domain ROOTS — so the
+    only cross-domain data a distributed run must exchange is the
+    per-domain-root boundary struct (one sorted index array per
+    domain), not the domain interiors.
+
+`symbolic_factorize_domains` is the single-process realization (used
+directly for its oracle tests and by the virtual-process tests); the
+multi-process wire layer that ships boundary structs between hosts is
+`parallel/psymbfact_dist.py`.  Output is bit-identical to
+`symbolic_factorize` — the decomposition regroups the same union
+recurrence (symbolic.py module docstring), it does not approximate it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .supernodes import SupernodePartition
+from .symbolic import SymbolicFactorization, _child_lists
+
+
+@dataclasses.dataclass
+class DomainPartition:
+    """A cut of the supernodal etree into process-independent work.
+
+    domains: (ndom, 2) int64 — inclusive supernode ranges [lo, hi];
+        postordering makes every complete subtree a contiguous range,
+        so two ints name one domain exactly.
+    owner: (ndom,) int64 — process assignment (LPT greedy by column
+        count, the psymbfact.c:393 process-subset slot).
+    top: (ntop,) int64 — sorted supernode ids in no domain.
+    """
+    domains: np.ndarray
+    owner: np.ndarray
+    top: np.ndarray
+    nproc: int
+
+    def owned(self, rank: int) -> np.ndarray:
+        return np.flatnonzero(self.owner == rank)
+
+
+def partition_domains(part: SupernodePartition, nproc: int,
+                      target_cols: int | None = None) -> DomainPartition:
+    """Cut the supernodal etree into maximal subtrees of ≤ target_cols
+    columns (default: n / (4·nproc), so ~4 domains per process for LPT
+    balance) plus the top remainder.  nproc=1 degenerates to one cut
+    too — the decomposition is the same, only ownership collapses."""
+    ns = part.nsuper
+    xsup = np.asarray(part.xsup, dtype=np.int64)
+    sparent = np.asarray(part.sparent, dtype=np.int64)
+    n = int(xsup[-1])
+    nproc = max(1, int(nproc))
+    if target_cols is None:
+        target_cols = max(1, -(-n // (4 * nproc)))
+
+    # postorder ⇒ subtree(s) = supernodes [first_desc[s], s]
+    first_desc = np.arange(ns, dtype=np.int64)
+    for s in range(ns):
+        p = sparent[s]
+        if p != -1 and first_desc[s] < first_desc[p]:
+            first_desc[p] = first_desc[s]
+    sub_cols = xsup[1:] - xsup[first_desc]
+
+    fits = sub_cols <= target_cols
+    parent_fits = np.zeros(ns, dtype=bool)
+    has_parent = sparent != -1
+    parent_fits[has_parent] = fits[sparent[has_parent]]
+    root_mask = fits & ~(has_parent & parent_fits)
+    roots = np.flatnonzero(root_mask)
+
+    domains = np.stack([first_desc[roots], roots], axis=1) \
+        if len(roots) else np.zeros((0, 2), dtype=np.int64)
+    covered = np.zeros(ns, dtype=bool)
+    for lo, hi in domains:
+        covered[lo:hi + 1] = True
+    top = np.flatnonzero(~covered)
+
+    # LPT greedy by column count: biggest domain to least-loaded proc
+    owner = np.zeros(len(domains), dtype=np.int64)
+    if nproc > 1 and len(domains):
+        work = (xsup[domains[:, 1] + 1] - xsup[domains[:, 0]])
+        load = np.zeros(nproc, dtype=np.int64)
+        for d in np.argsort(-work, kind="stable"):
+            p = int(np.argmin(load))
+            owner[d] = p
+            load[p] += int(work[d])
+    return DomainPartition(domains=domains, owner=owner, top=top,
+                           nproc=nproc)
+
+
+def slice_columns(b_indptr: np.ndarray, b_indices: np.ndarray,
+                  c0: int, c1: int):
+    """Column slice [c0, c1) of a CSC-like pattern, keeping GLOBAL
+    labels: returns (indptr_s, indices_s) where indptr_s is full
+    length n+1 but only columns [c0, c1) are populated (pointing into
+    the compact indices_s).  This is the exact payload a distributed
+    domain owner holds — nothing outside its columns."""
+    b_indptr = np.asarray(b_indptr, dtype=np.int64)
+    lo, hi = int(b_indptr[c0]), int(b_indptr[c1])
+    indptr_s = np.zeros(len(b_indptr), dtype=np.int64)
+    indptr_s[c0:c1 + 1] = b_indptr[c0:c1 + 1] - lo
+    # columns past the slice keep the slice's end so any accidental
+    # read of them sees an empty range, not garbage
+    indptr_s[c1 + 1:] = hi - lo
+    return indptr_s, np.asarray(b_indices[lo:hi], dtype=np.int64)
+
+
+def domain_symbfact(b_indptr: np.ndarray, b_indices: np.ndarray,
+                    part: SupernodePartition, lo: int, hi: int,
+                    threads: int = 1) -> List[np.ndarray]:
+    """Struct lists for domain supernodes [lo, hi] (a complete
+    subtree), reading only that domain's B columns.  Row labels in the
+    result are global.  Dispatches to the native union pass on a
+    column slice — the native kernel's per-supernode loop only touches
+    columns inside the xsup ranges it is given, so handing it the
+    domain's xsup window runs exactly the domain wave of
+    psymbfact.c:424."""
+    xsup = np.asarray(part.xsup, dtype=np.int64)
+    c0, c1 = int(xsup[lo]), int(xsup[hi + 1])
+    n = int(xsup[-1])
+    ndom = hi - lo + 1
+    sparent_d = np.asarray(part.sparent[lo:hi + 1], dtype=np.int64) - lo
+    sparent_d[ndom - 1] = -1  # domain root
+
+    indptr_s, indices_s = slice_columns(b_indptr, b_indices, c0, c1)
+
+    from ..utils.native import native_or_none
+    native = native_or_none()
+    if native is not None:
+        return native.symbfact(
+            n, indptr_s, indices_s, ndom,
+            np.ascontiguousarray(xsup[lo:hi + 2]),
+            np.ascontiguousarray(sparent_d), threads=max(1, threads))
+
+    struct: List[np.ndarray] = [None] * ndom  # type: ignore
+    children: List[list] = [[] for _ in range(ndom)]
+    for s in range(ndom - 1):
+        children[sparent_d[s]].append(s)
+    for s in range(ndom):
+        first, last = int(xsup[lo + s]), int(xsup[lo + s + 1] - 1)
+        pieces = [indices_s[indptr_s[j]:indptr_s[j + 1]]
+                  for j in range(first, last + 1)]
+        pieces += [struct[c] for c in children[s]]
+        rows = np.unique(np.concatenate(pieces)) if pieces \
+            else np.empty(0, np.int64)
+        struct[s] = rows[rows > last].astype(np.int64)
+    return struct
+
+
+def top_symbfact(b_indptr: np.ndarray, b_indices: np.ndarray,
+                 part: SupernodePartition, dp: DomainPartition,
+                 boundary: dict,
+                 children: List[np.ndarray] | None = None
+                 ) -> List[np.ndarray]:
+    """Struct lists for the top set given each domain ROOT's boundary
+    struct (`boundary[root_id] -> sorted global rows`).  This is the
+    interLvl/intraLvl wave: children of a top supernode are either
+    earlier top supernodes or domain roots, never domain interiors —
+    asserted, because that closure property is what bounds the
+    distributed exchange to one array per domain."""
+    xsup = np.asarray(part.xsup, dtype=np.int64)
+    is_top = np.zeros(part.nsuper, dtype=bool)
+    is_top[dp.top] = True
+    out: dict = {}
+    children = children if children is not None else _child_lists(part)
+    for s in dp.top:  # sorted ⇒ postorder ⇒ children before parents
+        first, last = int(xsup[s]), int(xsup[s + 1] - 1)
+        pieces = [b_indices[b_indptr[j]:b_indptr[j + 1]]
+                  for j in range(first, last + 1)]
+        for c in children[s]:
+            c = int(c)
+            if is_top[c]:
+                pieces.append(out[c])
+            else:
+                assert c in boundary, (
+                    f"top supernode {s}'s child {c} is neither top nor "
+                    "a domain root — domain cut is not subtree-closed")
+                pieces.append(boundary[c])
+        rows = np.unique(np.concatenate(pieces)) if pieces \
+            else np.empty(0, np.int64)
+        out[s] = rows[rows > last].astype(np.int64)
+    return [out[int(s)] for s in dp.top]
+
+
+def complete_from_domains(b_indptr: np.ndarray, b_indices: np.ndarray,
+                          part: SupernodePartition,
+                          dp: DomainPartition,
+                          struct: List[np.ndarray]
+                          ) -> SymbolicFactorization:
+    """Finish the decomposition once every domain slot of `struct` is
+    filled (top slots still None): derive the boundary map from the
+    domain roots, run the top wave, splice, assemble.  ONE completion
+    implementation shared by the local realization below and the
+    distributed wave (parallel/psymbfact_dist.py) — the boundary
+    keying and top splice must never diverge between them."""
+    boundary = {int(hi): struct[int(hi)] for _, hi in dp.domains}
+    children = _child_lists(part)
+    tstruct = top_symbfact(b_indptr, b_indices, part, dp, boundary,
+                           children=children)
+    for s, t in zip(dp.top, tstruct):
+        struct[int(s)] = t
+    return SymbolicFactorization(part=part, struct=struct,
+                                 children=children)
+
+
+def symbolic_factorize_domains(b_indptr: np.ndarray,
+                               b_indices: np.ndarray,
+                               part: SupernodePartition,
+                               nparts: int = 1,
+                               target_cols: int | None = None,
+                               threads: int = 1
+                               ) -> SymbolicFactorization:
+    """Single-process realization of the domain decomposition: run
+    every domain wave (each on its column slice), then the top wave
+    from the boundary structs.  Bit-identical to symbolic_factorize —
+    pinned by tests/test_psymbfact.py against both the python oracle
+    and the native whole-pattern pass."""
+    dp = partition_domains(part, nparts, target_cols)
+    struct: List[np.ndarray] = [None] * part.nsuper  # type: ignore
+    for lo, hi in dp.domains:
+        lo, hi = int(lo), int(hi)
+        struct[lo:hi + 1] = domain_symbfact(b_indptr, b_indices, part,
+                                            lo, hi, threads=threads)
+    return complete_from_domains(b_indptr, b_indices, part, dp, struct)

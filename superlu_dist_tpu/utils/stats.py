@@ -19,8 +19,8 @@ from typing import Dict
 # (a second factorization at refine precision) reports separately so
 # FACT's GFLOP/s never blends two differently-precisioned runs.
 PHASES = (
-    "EQUIL", "ROWPERM", "COLPERM", "ETREE", "SYMBFACT", "DIST",
-    "FACT", "FACT_ESC", "SOLVE", "REFINE", "SPMV",
+    "EQUIL", "ROWPERM", "COLPERM", "ETREE", "SYMBFACT", "GATHER",
+    "DIST", "FACT", "FACT_ESC", "SOLVE", "REFINE", "SPMV",
 )
 
 

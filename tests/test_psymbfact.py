@@ -1,0 +1,168 @@
+"""Domain-decomposed symbolic factorization (plan/psymbfact.py) — the
+symbfact_dist slot (SRC/psymbfact.c:150).
+
+What must hold for the decomposition to be a *distributed* algorithm
+and not just a refactor:
+
+  1. bit-identity with the whole-pattern pass, for any cut;
+  2. domain locality — a domain wave reads ONLY its own columns of B
+     (pinned by corrupting everything outside the slice);
+  3. the top wave consumes ONLY domain-root boundary structs (pinned
+     by wiping domain interiors before the top wave);
+  4. the cut itself is a partition into complete subtrees.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu.plan.etree import (col_counts_postordered,
+                                         etree_symmetric, postorder,
+                                         relabel_tree)
+from superlu_dist_tpu.plan.psymbfact import (domain_symbfact,
+                                             partition_domains,
+                                             slice_columns,
+                                             symbolic_factorize_domains,
+                                             top_symbfact)
+from superlu_dist_tpu.plan.supernodes import find_supernodes
+from superlu_dist_tpu.plan.symbolic import (symbolic_factorize,
+                                            symbolic_factorize_py)
+from superlu_dist_tpu.utils.testmat import laplacian_2d, laplacian_3d
+
+
+def _postordered_pattern(a_csr):
+    """(b_indptr, b_indices, part): the plan pipeline's symbfact inputs
+    (plan/plan.py ETREE+SYMBFACT stages), fill-reducing order applied
+    first exactly as plan_factorization does — under natural order a
+    banded matrix's etree is a path, which has no domain parallelism
+    at all (and partition_domains correctly returns ~one domain)."""
+    from superlu_dist_tpu.options import ColPerm
+    from superlu_dist_tpu.plan import colperm as colperm_mod
+    from superlu_dist_tpu.sparse import csr_from_scipy
+
+    n = a_csr.shape[0]
+    perm_c = colperm_mod.get_perm_c(csr_from_scipy(sp.csr_matrix(a_csr)),
+                                    ColPerm.METIS_AT_PLUS_A, None)
+    p = np.argsort(perm_c)  # new -> old
+    a_csr = sp.csr_matrix(a_csr)[p][:, p]
+    b = (a_csr + a_csr.T + sp.eye(n)).tocsr()
+    b.sort_indices()
+    parent1 = etree_symmetric(b.indptr.astype(np.int64),
+                              b.indices.astype(np.int64), n)
+    post = postorder(parent1)
+    parent = relabel_tree(parent1, post)
+    invpost = np.empty(n, dtype=np.int64)
+    invpost[post] = np.arange(n)
+    bp = b[post][:, post].tocsr()
+    bp.sort_indices()
+    b_indptr = bp.indptr.astype(np.int64)
+    b_indices = bp.indices.astype(np.int64)
+    colcount = col_counts_postordered(b_indptr, b_indices, parent)
+    part = find_supernodes(parent, colcount, relax=4, max_super=16)
+    return b_indptr, b_indices, part
+
+
+_CASES = [
+    laplacian_2d(9).to_scipy(),
+    laplacian_3d(5).to_scipy(),
+    sp.random(120, 120, density=0.04, random_state=7) + sp.eye(120),
+]
+
+
+@pytest.mark.parametrize("ai", range(len(_CASES)))
+@pytest.mark.parametrize("nparts", [1, 2, 4, 7])
+def test_domains_bit_identical_to_whole_pattern(ai, nparts):
+    b_indptr, b_indices, part = _postordered_pattern(_CASES[ai])
+    ref = symbolic_factorize_py(b_indptr, b_indices, part)
+    got = symbolic_factorize_domains(b_indptr, b_indices, part, nparts)
+    assert got.nsuper == ref.nsuper
+    for s in range(ref.nsuper):
+        np.testing.assert_array_equal(got.struct[s], ref.struct[s])
+    # and against the native whole-pattern pass (the production path)
+    nat = symbolic_factorize(b_indptr, b_indices, part)
+    for s in range(ref.nsuper):
+        np.testing.assert_array_equal(got.struct[s], nat.struct[s])
+
+
+@pytest.mark.parametrize("nparts", [2, 4])
+def test_partition_is_subtree_closed_cover(nparts):
+    _, _, part = _postordered_pattern(_CASES[0])
+    dp = partition_domains(part, nparts)
+    seen = np.zeros(part.nsuper, dtype=int)
+    for lo, hi in dp.domains:
+        assert 0 <= lo <= hi < part.nsuper
+        seen[lo:hi + 1] += 1
+        # complete subtree: every member's parent is inside, except
+        # the root's, which must leave the range
+        for s in range(lo, hi):
+            assert lo <= part.sparent[s] <= hi
+        assert part.sparent[hi] == -1 or part.sparent[hi] > hi
+    seen[dp.top] += 1
+    np.testing.assert_array_equal(seen, np.ones(part.nsuper, dtype=int))
+    assert len(dp.owner) == len(dp.domains)
+    assert dp.owner.max(initial=0) < nparts
+    if len(dp.domains) >= nparts:
+        # LPT must use every process when there is work to go around
+        assert len(np.unique(dp.owner)) == nparts
+
+
+def test_domain_wave_reads_only_its_columns():
+    """Corrupt B everywhere outside one domain's column range; that
+    domain's wave must be unaffected — the zero-communication claim of
+    psymbfact.c:424's domain phase, enforced by construction here."""
+    b_indptr, b_indices, part = _postordered_pattern(_CASES[1])
+    dp = partition_domains(part, 4)
+    assert len(dp.domains) >= 2
+    lo, hi = (int(v) for v in dp.domains[0])
+    clean = domain_symbfact(b_indptr, b_indices, part, lo, hi)
+    c0, c1 = int(part.xsup[lo]), int(part.xsup[hi + 1])
+    bad_indices = b_indices.copy()
+    bad_indices[:b_indptr[c0]] = 0
+    bad_indices[b_indptr[c1]:] = 0
+    dirty = domain_symbfact(b_indptr, bad_indices, part, lo, hi)
+    for a, b in zip(clean, dirty):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_top_wave_needs_only_root_boundaries():
+    """The top wave must consume exactly one struct per domain (the
+    root's) — hand it ONLY those and poison nothing else it could
+    reach; identical output proves the distributed exchange is one
+    boundary array per domain."""
+    b_indptr, b_indices, part = _postordered_pattern(_CASES[1])
+    dp = partition_domains(part, 4)
+    full = symbolic_factorize_py(b_indptr, b_indices, part)
+    boundary = {int(hi): full.struct[int(hi)] for _, hi in dp.domains}
+    tops = top_symbfact(b_indptr, b_indices, part, dp, boundary)
+    for s, t in zip(dp.top, tops):
+        np.testing.assert_array_equal(t, full.struct[int(s)])
+
+
+def test_slice_columns_payload_is_only_the_slice():
+    b_indptr, b_indices, _ = _postordered_pattern(_CASES[0])
+    n = len(b_indptr) - 1
+    c0, c1 = n // 4, n // 2
+    indptr_s, indices_s = slice_columns(b_indptr, b_indices, c0, c1)
+    assert len(indices_s) == b_indptr[c1] - b_indptr[c0]
+    np.testing.assert_array_equal(
+        indices_s, b_indices[b_indptr[c0]:b_indptr[c1]])
+    for j in range(c0, c1):
+        np.testing.assert_array_equal(
+            indices_s[indptr_s[j]:indptr_s[j + 1]],
+            b_indices[b_indptr[j]:b_indptr[j + 1]])
+    # out-of-slice columns read as empty, never as garbage
+    for j in list(range(0, c0)) + list(range(c1, n)):
+        assert indptr_s[j + 1] == indptr_s[j]
+
+
+def test_single_domain_whole_tree():
+    """target_cols >= n: one domain, empty top."""
+    b_indptr, b_indices, part = _postordered_pattern(_CASES[0])
+    n = int(part.xsup[-1])
+    dp = partition_domains(part, 1, target_cols=n)
+    assert len(dp.domains) == 1 and len(dp.top) == 0
+    got = symbolic_factorize_domains(b_indptr, b_indices, part, 1,
+                                     target_cols=n)
+    ref = symbolic_factorize_py(b_indptr, b_indices, part)
+    for s in range(ref.nsuper):
+        np.testing.assert_array_equal(got.struct[s], ref.struct[s])

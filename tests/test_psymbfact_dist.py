@@ -1,0 +1,328 @@
+"""Distributed planning from row-sliced input
+(parallel/psymbfact_dist.py) — the symbfact_dist / pdgsequ /
+dldperm_dist data-flow contracts (SRC/psymbfact.c:150,
+SRC/pdgsequ.c, SRC/pdgssvx.c:943).
+
+ThreadComm runs P real SPMD participants (one thread each) over
+barrier-synchronized collectives, so the multi-process code path —
+slice payloads, partial reductions, boundary exchange, rank-0
+broadcasts — executes for real, not via the nproc=1 degenerate path.
+Pinned:
+
+  1. every rank's plan is bit-identical to plan_factorization on the
+     assembled matrix (the SPMD contract);
+  2. numeric values NEVER enter the structure/symbfact collectives,
+     and with NOROWPERM they never enter ANY collective (the memory
+     model that distinguishes this path from gather-then-plan);
+  3. a rank-0 stage failure raises on every rank (no deadlock);
+  4. the local scaled-slice helper matches plan.scaled_values.
+"""
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from superlu_dist_tpu.options import ColPerm, Options, RowPerm
+from superlu_dist_tpu.parallel.psymbfact_dist import (
+    LocalComm, plan_factorization_dist, scaled_values_local)
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.sparse import CSRMatrix, csr_from_scipy
+from superlu_dist_tpu.utils.testmat import laplacian_3d, random_unsymmetric
+
+from test_multihost_plan import _assert_plans_equal
+
+
+class ThreadComm:
+    """P barrier-synchronized virtual processes.  One instance per
+    rank, sharing slots/barrier state — the collectives have real
+    allgather/bcast semantics (every rank deposits, barrier, every
+    rank reads), so ordering bugs and one-sided raises deadlock or
+    fail loudly instead of passing vacuously.  `spy` records every
+    payload that crossed a collective, for the no-values assertions."""
+
+    def __init__(self, nproc, rank, shared):
+        self.nproc = nproc
+        self.rank = rank
+        self._s = shared
+
+    @staticmethod
+    def make_group(nproc):
+        shared = {
+            "slots": [None] * nproc,
+            "barrier": threading.Barrier(nproc, timeout=60),
+            "spy": [],
+            "lock": threading.Lock(),
+        }
+        return [ThreadComm(nproc, r, shared) for r in range(nproc)]
+
+    def _exchange(self, payload):
+        s = self._s
+        s["slots"][self.rank] = payload
+        with s["lock"]:
+            s["spy"].append((self.rank, payload))
+        s["barrier"].wait()
+        out = list(s["slots"])
+        s["barrier"].wait()  # all read before any rank reuses slots
+        return out
+
+    def allgather(self, payload):
+        return self._exchange(payload)
+
+    def gather0(self, payload):
+        out = self._exchange(payload)
+        return out if self.rank == 0 else None
+
+    def bcast(self, payload):
+        out = self._exchange(payload if self.rank == 0 else b"")
+        return out[0]
+
+
+def _row_slices(a: CSRMatrix, nproc: int):
+    """Contiguous row blocks, deliberately uneven."""
+    cuts = np.linspace(0, a.m, nproc + 1).astype(np.int64)
+    cuts[1:-1] += np.arange(1, nproc) % 2  # un-even them a little
+    cuts = np.clip(cuts, 0, a.m)
+    out = []
+    for p in range(nproc):
+        lo, hi = int(cuts[p]), int(cuts[p + 1])
+        ip = a.indptr[lo:hi + 1] - a.indptr[lo]
+        sl = slice(int(a.indptr[lo]), int(a.indptr[hi]))
+        out.append((lo, ip.copy(), a.indices[sl].copy(),
+                    a.data[sl].copy()))
+    return out
+
+
+def _run_spmd(comms, fn):
+    """Run fn(rank_comm, rank) on every rank; collect per-rank
+    results/errors.  No barrier.abort() on failure: aborting races
+    with ranks still draining the same barrier generation (CPython
+    Barrier semantics) and corrupts THEIR error into
+    BrokenBarrierError; a genuinely one-sided death is broken by the
+    barrier's own 60 s timeout instead."""
+    results = [None] * len(comms)
+    errors = [None] * len(comms)
+
+    def work(r):
+        try:
+            results[r] = fn(comms[r], r)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,))
+               for r in range(len(comms))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+_MATS = [
+    laplacian_3d(5),
+    random_unsymmetric(150, density=0.05, seed=3),
+]
+
+
+@pytest.mark.parametrize("ai", range(len(_MATS)))
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_dist_plan_bit_identical_on_every_rank(ai, nproc):
+    a = _MATS[ai]
+    opts = Options()
+    ref = plan_factorization(a, opts)
+    comms = ThreadComm.make_group(nproc)
+    slices = _row_slices(a, nproc)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(e is None for e in errors), errors
+    for plan in results:
+        _assert_plans_equal(ref, plan)
+
+
+def test_values_never_in_structure_or_symbfact_collectives():
+    """With NOROWPERM nothing value-like crosses ANY collective: every
+    float64 array on the wire is O(n) (scale vectors, scalars), never
+    O(nnz) values — the distributed-memory claim itself."""
+    a = _MATS[0]
+    nproc = 4
+    opts = Options(row_perm=RowPerm.NOROWPERM)
+    comms = ThreadComm.make_group(nproc)
+    slices = _row_slices(a, nproc)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(e is None for e in errors), errors
+    ref = plan_factorization(a, opts)
+    _assert_plans_equal(ref, results[0])
+
+    data_bytes = {s[3].tobytes() for s in slices if len(s[3])}
+    for rank, payload in comms[0]._s["spy"]:
+        assert not any(db and db in payload for db in data_bytes), (
+            f"rank {rank} shipped its numeric values in a collective")
+
+
+def test_mc64_values_ride_only_the_rowperm_gather():
+    """With LargeDiag_MC64 the scaled values must appear in exactly
+    one collective (the rowperm gather0) — the dldperm_dist gather,
+    pdgssvx.c:943 — and in no other."""
+    a = _MATS[0]
+    nproc = 2
+    opts = Options(row_perm=RowPerm.LARGE_DIAG_MC64)
+    comms = ThreadComm.make_group(nproc)
+    slices = _row_slices(a, nproc)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(e is None for e in errors), errors
+    plan = results[0]
+    # scaled slice of rank 1, as the gather shipped it
+    sv1 = scaled_values_local(plan, slices[1][3], slices[1][0],
+                              slices[1][1])
+    hits = sum(1 for _, payload in comms[0]._s["spy"]
+               if sv1.tobytes() in payload)
+    assert hits == 1, f"scaled values crossed {hits} collectives"
+
+
+def test_rank0_only_failure_ships_to_all_ranks(monkeypatch):
+    """A failure in a stage that runs ONLY on process 0 (colperm) must
+    ride the error frame to every rank — non-root ranks never execute
+    the stage, so without the \\x01 frame they would hang in bcast."""
+    import superlu_dist_tpu.plan.colperm as colperm_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected colperm failure")
+
+    monkeypatch.setattr(colperm_mod, "get_perm_c", boom)
+    a = _MATS[0]
+    nproc = 3
+    comms = ThreadComm.make_group(nproc)
+    slices = _row_slices(a, nproc)
+    opts = Options(row_perm=RowPerm.NOROWPERM)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    for e in errors:
+        assert isinstance(e, RuntimeError), e
+        assert "injected colperm failure" in str(e)
+
+
+def test_symmetric_failure_raises_everywhere():
+    """A singular matrix fails the equilibration check symmetrically
+    (every rank holds the reduced vector); every rank must raise
+    instead of hanging in the next collective."""
+    n = 8
+    dense = sp.lil_matrix((n, n))
+    for i in range(n):
+        dense[i, 0] = 1.0  # all rows hit column 0 only + diagonal-ish
+    dense[0, 1] = 1.0
+    a = csr_from_scipy(sp.csr_matrix(dense))
+    nproc = 2
+    comms = ThreadComm.make_group(nproc)
+    slices = _row_slices(a, nproc)
+    opts = Options()  # equil sees empty columns -> rank-wide ValueError
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(isinstance(e, Exception) for e in errors), errors
+
+
+def test_complex_values_survive_empty_rank0_slice():
+    """Rank 0 owning a ZERO-row slice of a complex matrix must not
+    degrade the MC64 gather to real (the assembled value vector's
+    dtype must come from all parts, not rank 0's empty float64)."""
+    from superlu_dist_tpu.utils.testmat import helmholtz_2d
+    a = helmholtz_2d(7)
+    opts = Options(row_perm=RowPerm.LARGE_DIAG_MC64)
+    ref = plan_factorization(a, opts)
+    nproc = 2
+    comms = ThreadComm.make_group(nproc)
+    empty = (0, np.zeros(1, np.int64), np.zeros(0, np.int64),
+             np.zeros(0, np.float64))
+    whole = (0, a.indptr, a.indices, a.data)
+    slices = [empty, whole]
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(e is None for e in errors), errors
+    for plan in results:
+        _assert_plans_equal(ref, plan)
+
+
+def test_local_comm_matches_host_global_plan():
+    a = _MATS[1]
+    opts = Options(col_perm=ColPerm.METIS_AT_PLUS_A)
+    ref = plan_factorization(a, opts)
+    got = plan_factorization_dist(
+        0, a.indptr, a.indices, a.data, a.m, options=opts,
+        comm=LocalComm())
+    _assert_plans_equal(ref, got)
+
+
+def test_autotune_honored_identically():
+    """options.autotune must refit buckets on the dist path exactly as
+    plan_factorization does — a silent ignore would hand different
+    frontal plans to hosts using different plan entry points."""
+    a = _MATS[0]
+    opts = Options(autotune=True)
+    ref = plan_factorization(a, opts)
+    got = plan_factorization_dist(
+        0, a.indptr, a.indices, a.data, a.m, options=opts,
+        comm=LocalComm())
+    _assert_plans_equal(ref, got)
+
+
+def test_scaled_values_local_matches_global():
+    a = _MATS[0]
+    plan = plan_factorization(a, Options())
+    full = plan.scaled_values(a)
+    nproc = 3
+    for fst, ip, ix, dv in _row_slices(a, nproc):
+        sv = scaled_values_local(plan, dv, fst, ip)
+        lo = int(a.indptr[fst])
+        np.testing.assert_array_equal(sv, full[lo:lo + len(sv)])
+
+
+def test_my_perm_rejected_early():
+    """MY_PERMR/MY_PERMC cannot ride this signature; the rejection
+    must fire before any collective (not as a confusing rank-0
+    failure after an O(nnz) gather)."""
+    a = _MATS[0]
+    for o in (Options(row_perm=RowPerm.MY_PERMR),
+              Options(col_perm=ColPerm.MY_PERMC)):
+        with pytest.raises(ValueError, match="MY_PERMR/MY_PERMC"):
+            plan_factorization_dist(0, a.indptr, a.indices, a.data,
+                                    a.m, options=o, comm=LocalComm())
+
+
+def test_slice_length_mismatch_rejected():
+    a = _MATS[0]
+    plan = plan_factorization(a, Options())
+    with pytest.raises(ValueError, match="entries"):
+        scaled_values_local(plan, np.ones(3), 0, a.indptr[:5])
