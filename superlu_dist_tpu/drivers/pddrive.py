@@ -167,12 +167,14 @@ def _solve_fused(a, b, opts, stats):
         # uniform accounting per run; the escalated rerun reports
         # under its own FACT_ESC phase so FACT's GFLOP/s never blends
         # two differently-precisioned factorizations
+        from ..utils.platform import complex_device_gate
         fdt = effective_factor_dtype(a.dtype, dtype_name)
-        step = make_fused_solver(plan, dtype=fdt)
-        with stats.timer(phase):
-            x, berr, steps, tiny, _ = step(jnp.asarray(a.data),
-                                           jnp.asarray(b))
-            x.block_until_ready()
+        with complex_device_gate(fdt, a.dtype):
+            step = make_fused_solver(plan, dtype=fdt)
+            with stats.timer(phase):
+                x, berr, steps, tiny, _ = step(jnp.asarray(a.data),
+                                               jnp.asarray(b))
+                x.block_until_ready()
         stats.add_ops(phase, plan.factor_flops)
         stats.berr = float(berr)
         stats.refine_steps += int(steps)

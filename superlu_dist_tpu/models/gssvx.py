@@ -98,7 +98,16 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             f"backend={backend!r} conflicts with grid=; pass "
             "backend='dist' (or 'auto') for mesh execution")
 
-    with stats.timer(_phase):
+    from ..utils.platform import complex_device_gate, complex_mesh_blocked
+    if backend == "dist" and grid is not None and complex_mesh_blocked(
+            np.dtype(options.factor_dtype), getattr(grid, "mesh", grid)):
+        raise ValueError(
+            "complex factorization on a TPU mesh is disabled: "
+            "base-level complex lowering hangs on this platform "
+            "(TPU_SMOKE.jsonl c128_kernel; utils/platform.py). "
+            "Use a CPU mesh, or SLU_COMPLEX_TPU=1 to override.")
+    with complex_device_gate(np.dtype(options.factor_dtype)), \
+            stats.timer(_phase):
         if backend == "host":
             host_lu = ref_multifrontal.factorize_host(
                 plan, scaled, dtype=np.dtype(options.factor_dtype))
@@ -223,17 +232,20 @@ def solve(lu: LUFactorization, b: np.ndarray,
 
         solver = _solve_factored_trans
 
-    with stats.timer("SOLVE"):
-        x = from_factor_sol(solver(lu, to_factor_rhs(bb)))
+    from ..utils.platform import complex_device_gate
+    factor_dt = np.dtype(lu.effective_options.factor_dtype)
+    with complex_device_gate(factor_dt, bb.dtype):
+        with stats.timer("SOLVE"):
+            x = from_factor_sol(solver(lu, to_factor_rhs(bb)))
 
-    if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
-        from .refine import iterative_refine
-        with stats.timer("REFINE"):
-            x, berr, steps = iterative_refine(
-                lu, bb, x, solver, to_factor_rhs, from_factor_sol,
-                trans=(options.trans == Trans.TRANS))
-        stats.berr = berr
-        stats.refine_steps += steps
+        if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
+            from .refine import iterative_refine
+            with stats.timer("REFINE"):
+                x, berr, steps = iterative_refine(
+                    lu, bb, x, solver, to_factor_rhs, from_factor_sol,
+                    trans=(options.trans == Trans.TRANS))
+            stats.berr = berr
+            stats.refine_steps += steps
 
     return x[:, 0] if squeeze else x
 

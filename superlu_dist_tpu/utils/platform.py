@@ -1,0 +1,70 @@
+"""Platform-quirk gates.
+
+The one current gate: complex programs on the axon TPU client.
+Measured 2026-08-01 (TPU_SMOKE.jsonl, v5e hardware window): even a
+tiny jitted complex LU/GEMM program (`c128_kernel` — one 48×48
+partial_lu + one GEMM) wedges in compilation past a 240 s timeout,
+and so does the full complex solve, while the f32 pipeline compiles
+and runs clean (~92 s cold).  That bisect localizes the fault to
+base-level complex lowering on this platform — not to program size —
+so no amount of staging fixes it from our side.
+
+Policy (the "gate the complex path off-TPU and say so" branch of the
+round-4 decision tree, ROUND4.md): when the default JAX backend is a
+TPU, complex factor/solve programs are placed on the host CPU backend
+(`jax.default_device`), which is measured clean for the same
+programs.  The solver keeps WORKING for complex systems — config #4's
+cg20.cua-class problems (reference EXAMPLE/pzdrive3d.c) run on the
+CPU XLA client instead of hanging the accelerator.  Real programs are
+unaffected.
+
+Override: SLU_COMPLEX_TPU=1 re-enables on-accelerator complex — the
+re-test lever for future platform fixes; the hardware smoke's
+`c128_kernel` check is the cheap per-window probe of whether the
+underlying fault is gone (tools/tpu_smoke.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+
+
+def complex_needs_cpu(dtype) -> bool:
+    """True when `dtype` is complex and the default backend is a TPU
+    whose complex lowering is gated off (see module docstring)."""
+    if not np.issubdtype(np.dtype(dtype), np.complexfloating):
+        return False
+    if os.environ.get("SLU_COMPLEX_TPU", "0") == "1":
+        return False
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def complex_mesh_blocked(dtype, mesh) -> bool:
+    """True when a complex `dtype` is about to compile onto a mesh
+    containing TPU devices (and the override is not set).  Deliberately
+    independent of jax.default_backend(): a TPU mesh built while the
+    default backend is CPU would hit the same base-level lowering
+    wedge, so the mesh's own devices are the predicate."""
+    if not np.issubdtype(np.dtype(dtype), np.complexfloating):
+        return False
+    if os.environ.get("SLU_COMPLEX_TPU", "0") == "1":
+        return False
+    return any(d.platform == "tpu"
+               for d in np.asarray(mesh.devices).flat)
+
+
+@contextlib.contextmanager
+def complex_device_gate(*dtypes):
+    """Context manager: place jitted programs on the host CPU backend
+    when any of `dtypes` trips complex_needs_cpu; no-op otherwise.
+    Yields True when the gate engaged (for logging/telemetry)."""
+    if any(complex_needs_cpu(dt) for dt in dtypes):
+        import jax
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            yield True
+    else:
+        yield False

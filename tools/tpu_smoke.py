@@ -35,11 +35,18 @@ import time
 
 # registry of checks; each entry is executed via `tpu_smoke.py <name>`
 # in a child process so a wedged device RPC cannot starve later
-# checks.  c128_kernel runs BEFORE c128_solve to bisect the complex
-# wedge observed in the 2026-08-01 window: if the tiny kernel program
-# also hangs, complex lowering on this platform is broken at the base
-# level; if only the full solve hangs, the fault is in the big fused
-# program (compile scaling or a specific fusion).
+# checks.  The 08:27 2026-08-01 window ANSWERED the c128 bisect: the
+# tiny kernel program hangs exactly like the full solve (both
+# timeout>240s; f32 clean in ~92 s) — complex lowering on this
+# platform is broken at BASE level, so the complex path is now gated
+# onto the host CPU backend (utils/platform.py).  Roles since:
+#   c128_kernel — the raw platform probe: it calls jax.jit directly,
+#     BELOW the gate (which wraps only gssvx/solve/fused entry
+#     points), so it always measures the accelerator itself.  It
+#     stays red while the platform fault persists; the day it turns
+#     green is the signal to lift the gate's default.
+#   c128_solve — the USER path: gssvx on a complex system under the
+#     gate; must pass (placed on CPU) even on broken-platform windows.
 CHECKS = ("f32_ir_solve", "c128_kernel", "c128_solve",
           "pallas_compile")
 
@@ -87,18 +94,21 @@ def run_check(name):
                     gemm_finite=bool(np.all(np.isfinite(np.asarray(g)))))
 
     if name == "c128_solve":
-        # the complex path end-to-end on hardware (factor storage is
-        # complex; sweeps run the real-view codec)
+        # the complex USER path end-to-end: gssvx under the platform
+        # gate (utils/platform.py) — on a broken-complex accelerator
+        # this places on the host CPU backend and must still pass
         import scipy.sparse as sp
+        from superlu_dist_tpu.utils.platform import complex_needs_cpu
         ar = _build_matrix()
         rng = np.random.default_rng(1)
         az = ar.to_scipy().astype(np.complex128) \
             + 1j * sp.diags(rng.standard_normal(ar.n) * 0.1)
         az = csr_from_scipy(az.tocsr())
         xtrue = rng.standard_normal(az.n) + 1j * rng.standard_normal(az.n)
+        gated = bool(complex_needs_cpu(np.complex128))
         x, _, st = gssvx(Options(), az, az.to_scipy() @ xtrue)
         relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
-        return dict(relerr=relerr, berr=st.berr)
+        return dict(relerr=relerr, berr=st.berr, gated_to_cpu=gated)
 
     if name == "pallas_compile":
         from superlu_dist_tpu.ops.pallas_lu import partial_lu_batch_pallas
