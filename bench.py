@@ -39,6 +39,7 @@ tunnel death cannot eat the rest of a live hardware window.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -130,10 +131,152 @@ def _device_peak_tflops(dev) -> float:
     return 0.0
 
 
+_SCIPY_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SCIPY_BASELINE.json")
+
+
+def _host_fp() -> str:
+    # include_isa=False: the scipy baseline never touches XLA, so the
+    # --xla_cpu_max_isa cap must not split its cache (a primer run
+    # without the cap and a bench run with it are the same machine)
+    from superlu_dist_tpu.utils.cache import host_fingerprint
+    return "fp-" + host_fingerprint(include_isa=False)
+
+
+def _scipy_cache_load() -> dict:
+    try:
+        with open(_SCIPY_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _scipy_cache_get(desc: str):
+    """(t_scipy, ref_relerr) from a prior measurement ON THIS HOST,
+    else None.  The scipy baseline needs no accelerator, so a tunnel
+    window must never spend time on it — prime ahead of windows with
+    SLU_BENCH_PRIME_SCIPY=1 (the watcher does on first arm).  Host-
+    fingerprinted: a migrated VM re-measures instead of comparing a
+    TPU run against another machine's CPU seconds."""
+    rec = _scipy_cache_load().get(desc)
+    if rec and rec.get("host") == _host_fp():
+        return float(rec["t_scipy"]), float(rec["ref_relerr"])
+    return None
+
+
+def _scipy_cache_put(desc: str, t_scipy: float, ref_relerr: float):
+    # flock around the read-modify-write: the background primer and
+    # an in-window bench self-healing a miss may write concurrently,
+    # and a lost update here re-measures a 10+-minute baseline inside
+    # the next window
+    import fcntl
+    with open(_SCIPY_CACHE_PATH + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        data = _scipy_cache_load()
+        data[desc] = dict(t_scipy=t_scipy, ref_relerr=ref_relerr,
+                          host=_host_fp(),
+                          ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+        tmp = _SCIPY_CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, _SCIPY_CACHE_PATH)
+
+
+def _measure_scipy(a, b, xtrue):
+    """The reference arm: scipy SuperLU (serial CPU, f64)."""
+    import scipy.sparse.linalg as spla
+    acsc = a.to_scipy().tocsc()
+    t0 = time.perf_counter()
+    lu_ref = spla.splu(acsc)
+    x_ref = lu_ref.solve(b)
+    t_scipy = time.perf_counter() - t0
+    ref_relerr = np.linalg.norm(x_ref - xtrue) / np.linalg.norm(xtrue)
+    return t_scipy, ref_relerr
+
+
+def _fire_active() -> bool:
+    """True when tools/tpu_fire.sh (or a bench it spawned) is
+    running — the primer must not measure baselines under in-window
+    CPU contention."""
+    me = os.getpid()
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+            if "tpu_fire.sh" in cmd or "SLU_BENCH_CHILD" in cmd:
+                return True
+    except OSError:
+        pass
+    return False
+
+
+def _prime_scipy():
+    """SLU_BENCH_PRIME_SCIPY=1 entry: measure + cache the scipy
+    baselines for the primary and sweep-ladder configs, touching no
+    device — run OUTSIDE tunnel windows (2026-08-01: the n=262k sweep
+    config burned most of its 1500 s window budget on the scipy
+    solve and timed out mid-TPU-compile)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from superlu_dist_tpu.utils.testmat import (laplacian_2d,
+                                                laplacian_3d,
+                                                manufactured_rhs)
+    # mirror EXACTLY what a window runs (main + its sweep extras):
+    # primary (shape/k from env, main's per-shape default k), the
+    # many-RHS variant of the primary, then the sweep-ladder ks —
+    # which the sweep always runs as the 3D family regardless of the
+    # primary's shape
+    shape = os.environ.get("SLU_BENCH_SHAPE", "3d")
+    k = int(os.environ.get("SLU_BENCH_K",
+                           "30" if shape == "3d" else "160"))
+    nrhs = int(os.environ.get("SLU_BENCH_NRHS", "1"))
+    ladder = [(str(k), nrhs, shape)]
+    for nr_extra in (1, 64):  # the sweep's many-RHS config + default
+        if nr_extra != nrhs:
+            ladder.append((str(k), nr_extra, shape))
+    ladder += [(k2.strip(), 1, "3d") for k2 in os.environ.get(
+        "SLU_BENCH_SWEEP_KS", "48,64").split(",") if k2.strip()]
+    for kk, nr, shp in ladder:
+        if _fire_active():
+            # a window opened: stop measuring immediately — baseline
+            # seconds taken under in-window CPU contention would be
+            # cached as truth and overstate every later vs_baseline.
+            # The watcher relaunches the primer on its next dead-
+            # tunnel probe.
+            print(json.dumps({"primed": "aborted: fire active"}))
+            return
+        try:
+            kk = int(kk)
+            if shp == "3d":
+                a = laplacian_3d(kk)
+                desc = f"3D Laplacian n={kk ** 3}"
+            else:
+                a = laplacian_2d(kk)
+                desc = f"2D Laplacian n={kk ** 2}"
+        except (ValueError, MemoryError) as e:
+            # the sweep tolerates junk ladder entries (emits an error
+            # record); the primer must not die on them either
+            print(json.dumps({"primed": str(kk), "skipped": repr(e)}))
+            continue
+        if nr > 1:
+            desc += f" nrhs={nr}"
+        if _scipy_cache_get(desc) is not None:
+            print(json.dumps({"primed": desc, "cached": True}))
+            continue
+        xtrue, b = manufactured_rhs(a, nrhs=nr)
+        t_scipy, ref_relerr = _measure_scipy(a, b, xtrue)
+        _scipy_cache_put(desc, t_scipy, ref_relerr)
+        print(json.dumps({"primed": desc,
+                          "t_scipy": round(t_scipy, 3)}))
+        sys.stdout.flush()
+
+
 def _run_config(a, desc, nrhs, jnp):
     """Factor+solve one config; returns the result record."""
-    import scipy.sparse.linalg as spla
-
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.ops.batched import make_fused_solver
     from superlu_dist_tpu.plan.plan import plan_factorization
@@ -143,13 +286,20 @@ def _run_config(a, desc, nrhs, jnp):
     if nrhs > 1:
         desc += f" nrhs={nrhs}"
 
-    # --- baseline: scipy SuperLU (serial CPU, f64) ---
-    acsc = a.to_scipy().tocsc()
-    t0 = time.perf_counter()
-    lu_ref = spla.splu(acsc)
-    x_ref = lu_ref.solve(b)
-    t_scipy = time.perf_counter() - t0
-    ref_relerr = np.linalg.norm(x_ref - xtrue) / np.linalg.norm(xtrue)
+    # --- baseline: scipy SuperLU, cached across runs (see
+    # _scipy_cache_get) so accelerator windows spend zero time here;
+    # a cache miss measures and writes back (self-healing for new
+    # configs).  tau/cap annotations describe OUR solver arm, not the
+    # baseline — strip them from the key so A/B arms share one primed
+    # entry instead of each re-measuring in-window ---
+    cache_desc = re.sub(r" tau=[^ ]+", "", desc)
+    cached = _scipy_cache_get(cache_desc)
+    scipy_cached = cached is not None
+    if scipy_cached:
+        t_scipy, ref_relerr = cached
+    else:
+        t_scipy, ref_relerr = _measure_scipy(a, b, xtrue)
+        _scipy_cache_put(cache_desc, t_scipy, ref_relerr)
 
     # --- ours: fused f32 factor + f64 refine, ONE XLA program ---
     opts = Options(factor_dtype="float32")
@@ -175,13 +325,23 @@ def _run_config(a, desc, nrhs, jnp):
     x = np.asarray(x)
     x = x[:, 0] if xtrue.ndim == 1 else x
     relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
-    return dict(desc=desc, t_scipy=t_scipy, ref_relerr=ref_relerr,
-                t_plan=t_plan, t_warm=t_warm, best=best, relerr=relerr,
-                gflops=plan.factor_flops / best / 1e9,
-                accuracy_ok=bool(relerr < 1e-9))
+    rec = dict(desc=desc, t_scipy=t_scipy, ref_relerr=ref_relerr,
+               t_plan=t_plan, t_warm=t_warm, best=best, relerr=relerr,
+               gflops=plan.factor_flops / best / 1e9,
+               accuracy_ok=bool(relerr < 1e-9))
+    if scipy_cached:
+        # honesty marker: this record's baseline seconds are a prior
+        # same-host measurement, not concurrent with the device run
+        rec["scipy_cached"] = True
+    return rec
 
 
 def main():
+    if os.environ.get("SLU_BENCH_PRIME_SCIPY") == "1":
+        # baseline priming touches no device — safe anytime, cheap
+        # no-op once every ladder config is cached
+        _prime_scipy()
+        return
     # fused one-program execution for the measurement unless the
     # caller says otherwise: staged per-group dispatch trades compile
     # time for one host dispatch per group, which is invisible on a
@@ -341,8 +501,10 @@ def main():
         path = os.environ.get("SLU_BENCH_SWEEP_PATH") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
             "BENCH_SWEEP.jsonl")
-        # default keeps 3 children + the warm primary inside
-        # tpu_fire.sh's outer `timeout 5400`
+        # tpu_fire.sh raises this to 2400 with its outer timeout at
+        # 9000 (3 children x 2400 + the warm primary still fit); the
+        # bare-default pairing here (3 x 1500 + primary < 5400) is for
+        # direct `SLU_BENCH_SWEEP=1 python bench.py` runs
         budget = int(os.environ.get("SLU_SWEEP_CONFIG_TIMEOUT", "1500"))
 
         def emit(rec):

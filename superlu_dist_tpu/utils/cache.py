@@ -47,6 +47,20 @@ def cache_dir_for(base: str, accel: bool) -> str:
     return base + "-accel" if accel else host_cache_dir(base)
 
 
+def host_fingerprint(include_isa: bool = True) -> str:
+    """The 12-hex-digit host fingerprint used by host_cache_dir.
+
+    include_isa=False drops the XLA_FLAGS `--xla_cpu_max_isa` cap
+    from the key: the cap changes what XLA COMPILES, so XLA artifact
+    caches must split on it, but callers fingerprinting the host for
+    non-XLA measurements (bench.py's scipy-baseline cache) must NOT —
+    a primer run without the cap and a bench run with it are the same
+    machine, and splitting them re-measures every baseline in-window
+    (observed 2026-08-01: fp flip on the same host seconds apart,
+    keyed purely by whether ensure_portable_cpu_isa had run)."""
+    return _fingerprint(include_isa)
+
+
 def host_cache_dir(base: str) -> str:
     """`base` extended with a stable fingerprint of this host's CPU.
 
@@ -64,6 +78,10 @@ def host_cache_dir(base: str) -> str:
     native library (csrc slu_cpuid_words — the same instructions
     LLVM's host detection executes), with /proc/cpuinfo as additional
     salt and the platform strings as last resort."""
+    return f"{base}-{_fingerprint(True)}"
+
+
+def _fingerprint(include_isa: bool) -> str:
     parts = []
     try:
         from . import native
@@ -102,10 +120,11 @@ def host_cache_dir(base: str) -> str:
     # artifacts compiled under an ISA cap (--xla_cpu_max_isa, the
     # portability guard for live-migrating VMs) must not share a dir
     # with full-ISA artifacts from the same host
-    import os
-    m = re.search(r"--xla_cpu_max_isa=(\S+)",
-                  os.environ.get("XLA_FLAGS", ""))
-    if m:
-        parts.append(f"isa={m.group(1).lower()}")
+    if include_isa:
+        import os
+        m = re.search(r"--xla_cpu_max_isa=(\S+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m:
+            parts.append(f"isa={m.group(1).lower()}")
     key = "|".join(parts)
-    return f"{base}-{hashlib.sha1(key.encode()).hexdigest()[:12]}"
+    return hashlib.sha1(key.encode()).hexdigest()[:12]

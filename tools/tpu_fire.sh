@@ -72,11 +72,19 @@ stamp "smoke rc=$? -> $smoke_out"
 # the CPU rehearsal's budget claim is steps 1-2, which are the
 # whole <5-minute window plan.
 if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
-  # 3. Secondary configs (nrhs=64, n=262k) — sweep appends to
+  # 3. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
-  #    keeps the completed ones.
-  SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_SWEEP=1 timeout 5400 \
-    python "$repo/bench.py" >> "$log" 2>&1
+  #    keeps the completed ones.  Per-config budget 2400 s: the scipy
+  #    baselines are primed outside windows (SCIPY_BASELINE.json), so
+  #    the whole budget is device time — the 08:27 window's n=262k
+  #    config spent most of its 1500 s on the in-window scipy solve
+  #    and died mid-TPU-compile.
+  # outer 9000 > primary + 3 children x 2400: every config must get
+  # its full budget AND its per-config error record on timeout — an
+  # outer SIGKILL mid-child would lose the record silently
+  SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_SWEEP=1 \
+  SLU_SWEEP_CONFIG_TIMEOUT=${SLU_SWEEP_CONFIG_TIMEOUT:-2400} \
+    timeout 9000 python "$repo/bench.py" >> "$log" 2>&1
   stamp "sweep rc=$?"
   # 4. Pallas on-chip A/B (kernel-level; cheapest to lose).
   timeout 1800 python "$repo/tools/pallas_ab.py" >> "$log" 2>&1
