@@ -94,6 +94,18 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f"complex matrix: factor dtype mapped to {eff}")
         fdt = eff
+    try:
+        # accelerator-resolved runs get the measured-best
+        # amalgamation env defaults (utils/platform.py ladder); the
+        # CLI is about to drive this backend anyway, so resolving it
+        # here costs nothing extra.  User env always wins.
+        import jax
+        if jax.default_backend() != "cpu":
+            from ..utils.platform import apply_accel_amalg_defaults
+            apply_accel_amalg_defaults()
+    except Exception:
+        pass
+
     opts = Options(
         factor_dtype=fdt,
         equil=not args.no_equil,

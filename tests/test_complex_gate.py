@@ -103,6 +103,27 @@ def test_gated_gssvx_end_to_end(monkeypatch):
     assert relerr < 1e-12
 
 
+def test_accel_amalg_defaults(monkeypatch):
+    """apply_accel_amalg_defaults: measured TPU values as env
+    DEFAULTS (user env wins), and Options built afterwards pick them
+    up."""
+    from superlu_dist_tpu.options import Options as Opt
+    from superlu_dist_tpu.utils.platform import apply_accel_amalg_defaults
+
+    monkeypatch.delenv("SUPERLU_AMALG_TAU_PCT", raising=False)
+    monkeypatch.delenv("SUPERLU_AMALG_CAP", raising=False)
+    apply_accel_amalg_defaults()
+    import os
+    assert os.environ["SUPERLU_AMALG_TAU_PCT"] == "400"
+    assert os.environ["SUPERLU_AMALG_CAP"] == "1024"
+    o = Opt()
+    assert o.amalg_tau == 4.0 and o.amalg_cap == 1024
+    # user env wins
+    monkeypatch.setenv("SUPERLU_AMALG_TAU_PCT", "150")
+    apply_accel_amalg_defaults()
+    assert os.environ["SUPERLU_AMALG_TAU_PCT"] == "150"
+
+
 def test_complex_tpu_mesh_rejected(monkeypatch):
     """backend='dist' with a TPU mesh and a complex dtype must fail
     fast with the documented message, not hang in compilation."""
