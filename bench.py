@@ -452,6 +452,11 @@ def main():
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    SLU_BENCH_CHILD="1",
                    SLU_BENCH_FAIL_REASON=f"runtime:{type(e).__name__}")
+        # the CPU child must not inherit the ACCELERATOR amalgamation
+        # trade this process env-defaulted (measured worse on CPU)
+        from superlu_dist_tpu.utils.platform import (
+            strip_accel_amalg_defaults)
+        env = strip_accel_amalg_defaults(env)
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
 

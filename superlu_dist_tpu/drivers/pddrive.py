@@ -94,17 +94,23 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f"complex matrix: factor dtype mapped to {eff}")
         fdt = eff
-    try:
-        # accelerator-resolved runs get the measured-best
-        # amalgamation env defaults (utils/platform.py ladder); the
-        # CLI is about to drive this backend anyway, so resolving it
-        # here costs nothing extra.  User env always wins.
+    # accelerator-resolved runs get the measured-best amalgamation
+    # env defaults (utils/platform.py ladder); the CLI is about to
+    # drive this backend anyway, so resolving it here costs nothing
+    # extra.  User env always wins.  NOT applied when the numeric
+    # phase will actually run on CPU: an explicit --backend host, or
+    # a complex system the platform gate reroutes off-TPU — the
+    # accelerator trade is measured WORSE there.
+    from ..utils.platform import (apply_accel_amalg_defaults,
+                                  complex_needs_cpu)
+    if args.backend != "host" and not complex_needs_cpu(np.dtype(fdt)):
         import jax
-        if jax.default_backend() != "cpu":
-            from ..utils.platform import apply_accel_amalg_defaults
+        try:
+            accel = jax.default_backend() != "cpu"
+        except RuntimeError:  # no backend reachable -> CPU-class run
+            accel = False
+        if accel:
             apply_accel_amalg_defaults()
-    except Exception:
-        pass
 
     opts = Options(
         factor_dtype=fdt,

@@ -63,9 +63,29 @@ def apply_accel_amalg_defaults() -> None:
     through the measured ladder.  On CPU the same trade LOSES
     (round-4 measurement at n=27k) — flops are not free there — so
     these defaults apply only on accelerator-resolved paths and the
-    library default stays CPU-safe."""
-    os.environ.setdefault("SUPERLU_AMALG_TAU_PCT", "400")
-    os.environ.setdefault("SUPERLU_AMALG_CAP", "1024")
+    library default stays CPU-safe.
+
+    The keys THIS call set (vs user-set) are recorded in
+    SLU_ACCEL_AMALG_APPLIED so a CPU-fallback re-exec (bench.py) can
+    strip exactly them — the CPU child must not inherit the
+    accelerator trade."""
+    applied = []
+    for k, v in (("SUPERLU_AMALG_TAU_PCT", "400"),
+                 ("SUPERLU_AMALG_CAP", "1024")):
+        if k not in os.environ:
+            os.environ[k] = v
+            applied.append(k)
+    if applied:
+        os.environ["SLU_ACCEL_AMALG_APPLIED"] = ",".join(applied)
+
+
+def strip_accel_amalg_defaults(env: dict) -> dict:
+    """Remove from `env` the amalgamation keys that
+    apply_accel_amalg_defaults (not the user) set — for handing a
+    clean environment to a CPU child process."""
+    for k in env.pop("SLU_ACCEL_AMALG_APPLIED", "").split(","):
+        env.pop(k, None)
+    return env
 
 
 def complex_mesh_blocked(dtype, mesh) -> bool:

@@ -107,21 +107,40 @@ def test_accel_amalg_defaults(monkeypatch):
     """apply_accel_amalg_defaults: measured TPU values as env
     DEFAULTS (user env wins), and Options built afterwards pick them
     up."""
-    from superlu_dist_tpu.options import Options as Opt
-    from superlu_dist_tpu.utils.platform import apply_accel_amalg_defaults
-
-    monkeypatch.delenv("SUPERLU_AMALG_TAU_PCT", raising=False)
-    monkeypatch.delenv("SUPERLU_AMALG_CAP", raising=False)
-    apply_accel_amalg_defaults()
     import os
+
+    from superlu_dist_tpu.options import Options as Opt
+    from superlu_dist_tpu.utils.platform import (
+        apply_accel_amalg_defaults, strip_accel_amalg_defaults)
+
+    # first-touch each key THROUGH monkeypatch so teardown restores
+    # the pre-test state even though apply_* writes via os.environ
+    # directly (setenv records "absent" as the original; a bare
+    # delenv(raising=False) on an unset var records nothing and the
+    # values would leak into every later test's Options())
+    for k in ("SUPERLU_AMALG_TAU_PCT", "SUPERLU_AMALG_CAP",
+              "SLU_ACCEL_AMALG_APPLIED"):
+        monkeypatch.setenv(k, "tracked")
+        monkeypatch.delenv(k)
+    apply_accel_amalg_defaults()
     assert os.environ["SUPERLU_AMALG_TAU_PCT"] == "400"
     assert os.environ["SUPERLU_AMALG_CAP"] == "1024"
+    assert sorted(os.environ["SLU_ACCEL_AMALG_APPLIED"].split(",")) \
+        == ["SUPERLU_AMALG_CAP", "SUPERLU_AMALG_TAU_PCT"]
     o = Opt()
     assert o.amalg_tau == 4.0 and o.amalg_cap == 1024
-    # user env wins
+    # a CPU child env gets exactly the applied keys stripped
+    env = strip_accel_amalg_defaults(dict(os.environ))
+    assert "SUPERLU_AMALG_TAU_PCT" not in env
+    assert "SUPERLU_AMALG_CAP" not in env
+    assert "SLU_ACCEL_AMALG_APPLIED" not in env
+    # user env wins and is NOT recorded as applied (so never stripped)
     monkeypatch.setenv("SUPERLU_AMALG_TAU_PCT", "150")
+    monkeypatch.delenv("SUPERLU_AMALG_CAP")
+    monkeypatch.delenv("SLU_ACCEL_AMALG_APPLIED")
     apply_accel_amalg_defaults()
     assert os.environ["SUPERLU_AMALG_TAU_PCT"] == "150"
+    assert os.environ["SLU_ACCEL_AMALG_APPLIED"] == "SUPERLU_AMALG_CAP"
 
 
 def test_complex_tpu_mesh_rejected(monkeypatch):
