@@ -24,7 +24,7 @@ from . import colperm as colperm_mod
 from . import equilibrate, rowperm
 from .etree import (col_counts_postordered, etree_symmetric, postorder,
                     relabel_tree)
-from .frontal import FrontalPlan, build_frontal_plan
+from .frontal import FrontalPlan, build_frontal_plan, front_flops
 from .supernodes import find_supernodes
 from .symbolic import amalgamate, symbolic_factorize
 
@@ -49,6 +49,13 @@ class FactorPlan:
     # symbolic + frontal structure
     frontal: FrontalPlan
     anorm: float
+    # factorization flops of the UNAMALGAMATED structure — the honest
+    # useful-work denominator for GFLOP/s reporting: amalgamation
+    # (symbolic.amalgamate) grows executed flops by design (explicit
+    # zeros the MXU churns for latency wins), so frontal.factor_flops
+    # over-counts useful work at high tau.  0.0 on plans predating
+    # this field.
+    true_factor_flops: float = 0.0
 
     @property
     def nsuper(self) -> int:
@@ -180,6 +187,9 @@ def plan_from_perms(n: int, options: Options, stats: Stats,
                                      threads=options.symb_threads)
         else:
             sym = symbfact_fn(b_indptr, b_indices, part)
+        w0 = np.diff(sym.part.xsup).astype(np.int64)
+        r0 = np.array([len(t) for t in sym.struct], dtype=np.int64)
+        true_factor_flops = float(np.sum(front_flops(w0, r0)))
         sym = amalgamate(sym, options.amalg_tau, options.amalg_cap)
 
     # [Dist-plan] frontal maps (the pddistribute analog — here it
@@ -195,7 +205,8 @@ def plan_from_perms(n: int, options: Options, stats: Stats,
         perm_r=perm_r, perm_c=perm_c, post=post,
         final_row=final_row, final_col=final_col,
         coo_rows=coo_rows, coo_cols=coo_cols,
-        frontal=frontal, anorm=anorm)
+        frontal=frontal, anorm=anorm,
+        true_factor_flops=true_factor_flops)
     if autotune:
         from .autotune import autotuned_options
         tuned = autotuned_options(plan, options)
