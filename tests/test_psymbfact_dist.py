@@ -49,10 +49,13 @@ class ThreadComm:
         self._s = shared
 
     @staticmethod
-    def make_group(nproc):
+    def make_group(nproc, timeout=60):
+        # timeout: deadlock breaker only.  Raise it for scale tests —
+        # P CPU-bound ranks timeshare the host, so the first barrier
+        # arrival legitimately waits ~(P-1)x one rank's phase time.
         shared = {
             "slots": [None] * nproc,
-            "barrier": threading.Barrier(nproc, timeout=60),
+            "barrier": threading.Barrier(nproc, timeout=timeout),
             "spy": [],
             "lock": threading.Lock(),
         }
@@ -101,7 +104,8 @@ def _run_spmd(comms, fn):
     with ranks still draining the same barrier generation (CPython
     Barrier semantics) and corrupts THEIR error into
     BrokenBarrierError; a genuinely one-sided death is broken by the
-    barrier's own 60 s timeout instead."""
+    barrier's configured timeout instead (make_group's `timeout`,
+    default 60 s, raised for scale tests)."""
     results = [None] * len(comms)
     errors = [None] * len(comms)
 
@@ -319,6 +323,41 @@ def test_my_perm_rejected_early():
         with pytest.raises(ValueError, match="MY_PERMR/MY_PERMC"):
             plan_factorization_dist(0, a.indptr, a.indices, a.data,
                                     a.m, options=o, comm=LocalComm())
+
+
+@pytest.mark.scale
+def test_dist_plan_at_target_scale_262k():
+    """Distributed planning at the BASELINE config #3 envelope: the
+    k=64 3D Laplacian (n=262,144) planned by 4 SPMD ranks from row
+    slices must be bit-identical to the host-global plan — certifies
+    the domain decomposition, the boundary exchange, and the O(nnz)
+    wire payloads at production scale (scale marker: ~minutes on a
+     1-core host)."""
+    import time
+
+    a = laplacian_3d(64)
+    opts = Options()
+    t0 = time.perf_counter()
+    ref = plan_factorization(a, opts)
+    t_host = time.perf_counter() - t0
+    nproc = 4
+    comms = ThreadComm.make_group(nproc, timeout=1800)
+    slices = _row_slices(a, nproc)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    t0 = time.perf_counter()
+    results, errors = _run_spmd(comms, run)
+    t_dist = time.perf_counter() - t0
+    assert all(e is None for e in errors), errors
+    for plan in results:
+        _assert_plans_equal(ref, plan)
+    print(f"\n262k dist-plan: host {t_host:.1f}s, 4-rank SPMD "
+          f"{t_dist:.1f}s, nsuper {ref.nsuper}, "
+          f"lu_nnz {ref.lu_nnz()}")
 
 
 def test_slice_length_mismatch_rejected():
