@@ -27,6 +27,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import jax
+
+# re-assert the caller's platform choice via jax.config: with the
+# accelerator plugin on PYTHONPATH the env var alone is ignored and a
+# dead tunnel blocks backend init forever (bench.py idiom)
+_envp = os.environ.get("JAX_PLATFORMS")
+if _envp:
+    try:
+        jax.config.update("jax_platforms", _envp)
+    except Exception:
+        pass
 import jax.numpy as jnp
 
 

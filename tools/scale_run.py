@@ -45,6 +45,16 @@ def main():
         os.environ.get("XLA_FLAGS", ""))
     import jax
 
+    # re-assert the caller's platform choice via jax.config: with the
+    # accelerator plugin on PYTHONPATH the env var alone is ignored
+    # and a dead tunnel blocks backend init forever (bench.py idiom)
+    envp = os.environ.get("JAX_PLATFORMS")
+    if envp:
+        try:
+            jax.config.update("jax_platforms", envp)
+        except Exception:
+            pass
+
     # cache dir from the RESOLVED device (bench.py discipline): a
     # live-window scale run compiles expensive TPU programs that must
     # land in the stable shared accel dir, not a host-fingerprinted

@@ -89,29 +89,31 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   # 4. Pallas on-chip A/B (kernel-level; cheapest to lose).
   timeout 1800 python "$repo/tools/pallas_ab.py" >> "$log" 2>&1
   stamp "pallas_ab rc=$?"
-  # 5. Amalgamation-tau A/B on the primary config (long windows
-  #    only — each variant recompiles).  The TPU run is latency-
-  #    bound (MFU 0.01% measured 2026-08-01): merging supernodes
-  #    trades cheap MXU flops for fewer sequential level steps, and
-  #    only hardware can price that trade.  Compare `best` (wall)
-  #    across records in TPU_AB_TAU.jsonl, not GFLOP/s (flops grow
-  #    with tau by construction).  tau=100 is the self-contained
-  #    baseline arm (the default shape, with `best` recorded —
-  #    TPU_BENCH_LIVE.json carries only GFLOP/s).  A CPU-fallback
-  #    arm is discarded: mixing CPU seconds into the comparison
-  #    would misprice the trade.
-  for tau in 100 200 400; do
+  # 5. Amalgamation A/B on the primary config (long windows only —
+  #    each variant recompiles).  The TPU run is latency-bound (MFU
+  #    0.01% measured 2026-08-01): merging supernodes trades cheap
+  #    MXU flops for fewer sequential level steps, and only hardware
+  #    can price that trade.  Compare `best` (wall) across records in
+  #    TPU_AB_TAU.jsonl, not GFLOP/s (flops grow with tau by
+  #    construction).  The 2026-08-01 ladder measured monotone wins
+  #    through tau=400/cap=1024 (0.952→0.815 s; now the accelerator
+  #    default) without finding the knee, so the arms probe PAST the
+  #    default: cap=2048 and tau=800.  A CPU-fallback arm is
+  #    discarded: mixing CPU seconds into the comparison would
+  #    misprice the trade.
+  for arm in 400:1024 400:2048 800:2048; do
+    tau=${arm%%:*}; cap=${arm##*:}
     ab_tmp=$(mktemp)
     SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
-    SUPERLU_AMALG_TAU_PCT=$tau SUPERLU_AMALG_CAP=1024 \
+    SUPERLU_AMALG_TAU_PCT=$tau SUPERLU_AMALG_CAP=$cap \
       timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
     rc=$?
     if grep -q '"cpu_fallback": false' "$ab_tmp"; then
       cat "$ab_tmp" >> "$repo/TPU_AB_TAU.jsonl"
-      stamp "amalg tau=$tau rc=$rc (recorded)"
+      stamp "amalg tau=$tau cap=$cap rc=$rc (recorded)"
     else
       cat "$ab_tmp" >> "$log"
-      stamp "amalg tau=$tau rc=$rc fell back/failed; discarded"
+      stamp "amalg tau=$tau cap=$cap rc=$rc fell back/failed; discarded"
     fi
     rm -f "$ab_tmp"
   done
