@@ -18,6 +18,7 @@ Pinned:
   4. the local scaled-slice helper matches plan.scaled_values.
 """
 
+import os
 import pickle
 import threading
 
@@ -25,7 +26,7 @@ import numpy as np
 import pytest
 import scipy.sparse as sp
 
-from superlu_dist_tpu.options import ColPerm, Options, RowPerm
+from superlu_dist_tpu.options import ColPerm, Options, RowPerm, YesNo
 from superlu_dist_tpu.parallel.psymbfact_dist import (
     LocalComm, plan_factorization_dist, scaled_values_local)
 from superlu_dist_tpu.plan.plan import plan_factorization
@@ -83,19 +84,26 @@ class ThreadComm:
         return out[0]
 
 
-def _row_slices(a: CSRMatrix, nproc: int):
-    """Contiguous row blocks, deliberately uneven."""
-    cuts = np.linspace(0, a.m, nproc + 1).astype(np.int64)
-    cuts[1:-1] += np.arange(1, nproc) % 2  # un-even them a little
-    cuts = np.clip(cuts, 0, a.m)
+def _slices_from_cuts(a: CSRMatrix, cuts):
+    """NRformat_loc row slices for the given cut positions (one
+    implementation of the slice layout, shared by the even-split and
+    fuzz-random-cut callers)."""
     out = []
-    for p in range(nproc):
+    for p in range(len(cuts) - 1):
         lo, hi = int(cuts[p]), int(cuts[p + 1])
         ip = a.indptr[lo:hi + 1] - a.indptr[lo]
         sl = slice(int(a.indptr[lo]), int(a.indptr[hi]))
         out.append((lo, ip.copy(), a.indices[sl].copy(),
                     a.data[sl].copy()))
     return out
+
+
+def _row_slices(a: CSRMatrix, nproc: int):
+    """Contiguous row blocks, deliberately uneven."""
+    cuts = np.linspace(0, a.m, nproc + 1).astype(np.int64)
+    cuts[1:-1] += np.arange(1, nproc) % 2  # un-even them a little
+    cuts = np.clip(cuts, 0, a.m)
+    return _slices_from_cuts(a, cuts)
 
 
 def _run_spmd(comms, fn):
@@ -323,6 +331,54 @@ def test_my_perm_rejected_early():
         with pytest.raises(ValueError, match="MY_PERMR/MY_PERMC"):
             plan_factorization_dist(0, a.indptr, a.indices, a.data,
                                     a.m, options=o, comm=LocalComm())
+
+
+_FUZZ_CASES = list(range(int(
+    os.environ.get("SLU_DIST_PLAN_FUZZ_CASES", "8"))))
+
+
+@pytest.mark.parametrize("case", _FUZZ_CASES)
+def test_fuzz_dist_plan_matches_host(case):
+    """Seeded sweep over the jagged middle of the distributed-plan
+    input space: random unsymmetric diag-dominant systems × random
+    UNEVEN slice cuts (zero-row slices included — legal NRformat_loc
+    participants) × P ∈ {2,3,5} × row-perm mode × equil — every rank's
+    plan must equal the host-global plan bit-for-bit.  Widen with
+    SLU_DIST_PLAN_FUZZ_CASES (seed-deterministic per case)."""
+    rng = np.random.default_rng(9000 + case)
+    n = int(rng.integers(40, 160))
+    m = sp.random(n, n, density=float(rng.uniform(0.02, 0.08)),
+                  random_state=np.random.RandomState(
+                      int(rng.integers(2**31))), format="lil")
+    d = 1.0 + np.abs(rng.standard_normal(n))
+    m.setdiag(d + np.asarray(np.abs(m).sum(axis=1)).ravel())
+    A = m.tocsr()
+    A.sort_indices()
+    a = csr_from_scipy(A)
+
+    nproc = int(rng.choice([2, 3, 5]))
+    opts = Options(
+        row_perm=RowPerm.LARGE_DIAG_MC64 if rng.integers(2)
+        else RowPerm.NOROWPERM,
+        equil=YesNo.YES if rng.integers(2) else YesNo.NO)
+    ref = plan_factorization(a, opts)
+
+    # random cuts, possibly degenerate (empty slices)
+    cuts = np.sort(rng.integers(0, a.m + 1, size=nproc - 1))
+    cuts = np.concatenate([[0], cuts, [a.m]])
+    slices = _slices_from_cuts(a, cuts)
+
+    comms = ThreadComm.make_group(nproc)
+
+    def run(comm, r):
+        fst, ip, ix, dv = slices[r]
+        return plan_factorization_dist(fst, ip, ix, dv, a.m,
+                                       options=opts, comm=comm)
+
+    results, errors = _run_spmd(comms, run)
+    assert all(e is None for e in errors), errors
+    for plan in results:
+        _assert_plans_equal(ref, plan)
 
 
 @pytest.mark.scale
