@@ -72,6 +72,13 @@ stamp "smoke rc=$? -> $smoke_out"
 # the CPU rehearsal's budget claim is steps 1-2, which are the
 # whole <5-minute window plan.
 if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
+  # 2.5 One profiled step of the warm fused solver -> committed
+  #     op-level summary (TPU_PROFILE_r04.json; raw trace stays in
+  #     gitignored .tpu_trace/).  Early in the sequence: ~2 min warm,
+  #     and the per-op device-time breakdown is the round-5
+  #     optimization starting point for the latency-bound regime.
+  timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
+  stamp "profile rc=$?"
   # 3. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy

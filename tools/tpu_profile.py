@@ -1,0 +1,125 @@
+"""Capture ONE profiled step of the fused solver on the ambient
+accelerator and commit a compact op-level summary.
+
+The round-4 hardware story is latency-bound (MFU ~0.01%), and the tau
+A/B could only price one lever blind; the trace says WHERE the step's
+wall actually goes (per-op device time, gaps, transfers), which is the
+round-5 optimization starting point.  Raw traces are big and stay in
+the gitignored .tpu_trace/ dir; the committed artifact is
+TPU_PROFILE_r04.json — per-plane top events by total duration.
+
+Run by tpu_fire.sh (step 6) on a live tunnel; SLU_PROFILE_DRYRUN=1
+runs the same path on CPU (host planes only) for plumbing tests.
+
+The xplane parse rides tensorflow's bundled proto
+(tensorflow.tsl.profiler.protobuf.xplane_pb2) under the pure-python
+protobuf implementation — the tensorboard_plugin_profile converters
+in this image predate the installed TF and cannot load
+(xspace_to_tools_data missing), so the aggregation here is
+deliberately proto-level and generic: sum of event durations grouped
+by (plane, line, event name).
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                      "python")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_DIR = os.path.join(REPO, ".tpu_trace")
+OUT = os.environ.get("SLU_PROFILE_OUT",
+                     os.path.join(REPO, "TPU_PROFILE_r04.json"))
+
+
+def capture():
+    dryrun = os.environ.get("SLU_PROFILE_DRYRUN") == "1"
+    if dryrun:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if dryrun:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.platform import (
+        apply_accel_amalg_defaults)
+    from superlu_dist_tpu.utils.testmat import (laplacian_3d,
+                                                manufactured_rhs)
+
+    dev = jax.devices()[0]
+    if dev.platform != "cpu":
+        apply_accel_amalg_defaults()
+        from superlu_dist_tpu.utils.cache import cache_dir_for
+        jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+            os.path.join(REPO, ".jax_cache"), accel=True))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1)
+
+    k = int(os.environ.get("SLU_PROFILE_K", "8" if dryrun else "30"))
+    a = laplacian_3d(k)
+    plan = plan_factorization(a, Options(factor_dtype="float32"),
+                              autotune=True)
+    step = make_fused_solver(plan, dtype="float32")
+    _, b = manufactured_rhs(a)
+    v, bb = jnp.asarray(a.data), jnp.asarray(b[:, None])
+    step(v, bb)[0].block_until_ready()  # compile + warm outside trace
+    t0 = time.perf_counter()
+    with jax.profiler.trace(TRACE_DIR):
+        step(v, bb)[0].block_until_ready()
+    wall = time.perf_counter() - t0
+    return dict(device=str(dev), device_kind=getattr(
+        dev, "device_kind", dev.platform), n=a.n,
+        profiled_step_wall_s=wall)
+
+
+def summarize(meta, top=40):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = sorted(glob.glob(TRACE_DIR + "/**/*.xplane.pb",
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise SystemExit("no xplane.pb captured under " + TRACE_DIR)
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    planes = []
+    for p in xs.planes:
+        agg = {}
+        for line in p.lines:
+            for ev in line.events:
+                key = (line.name,
+                       p.event_metadata[ev.metadata_id].name)
+                tot, cnt = agg.get(key, (0, 0))
+                agg[key] = (tot + ev.duration_ps, cnt + 1)
+        if not agg:
+            continue
+        events = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+        planes.append(dict(
+            plane=p.name,
+            events=[dict(line=ln, op=op_name,
+                         total_ms=round(ps / 1e9, 4), count=cnt)
+                    for (ln, op_name), (ps, cnt) in events]))
+    return dict(meta, ts=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                xplane=os.path.relpath(paths[-1], REPO),
+                planes=planes)
+
+
+def main():
+    meta = capture()
+    rec = summarize(meta)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    dev_planes = [p["plane"] for p in rec["planes"]]
+    print(json.dumps(dict(profile=OUT, wall_s=meta[
+        "profiled_step_wall_s"], planes=dev_planes)))
+
+
+if __name__ == "__main__":
+    main()
