@@ -114,8 +114,12 @@ def summarize(meta, top=40):
 def main():
     meta = capture()
     rec = summarize(meta)
-    with open(OUT, "w") as f:
+    # atomic promote: the fire step's timeout may SIGKILL mid-write,
+    # and a truncated committed artifact is worse than a stale one
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(rec, f, indent=1)
+    os.replace(tmp, OUT)
     dev_planes = [p["plane"] for p in rec["planes"]]
     print(json.dumps(dict(profile=OUT, wall_s=meta[
         "profiled_step_wall_s"], planes=dev_planes)))
