@@ -31,7 +31,8 @@ while :; do
     stamp "tunnel LIVE -> firing"
     bash "$repo/tools/tpu_fire.sh"
     stamp "fire sequence returned"
-  elif ! pgrep -f "bench._prime_scipy" >/dev/null 2>&1; then
+  elif [ ! "$repo/SCIPY_BASELINE.json.primed" -nt "$repo/bench.py" ] \
+       && ! pgrep -f "bench._prime_scipy" >/dev/null 2>&1; then
     # dead tunnel = the right time to prime the scipy baselines
     # (CPU-only, ~20-30 min cold, no-op once cached) so windows
     # never spend tunnel time on them.  Launched via -c so the
