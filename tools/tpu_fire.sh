@@ -79,6 +79,12 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #     optimization starting point for the latency-bound regime.
   timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
   stamp "profile rc=$?"
+  # and the n=110,592 step (warm executable from the sweep cache):
+  # the scale regime's op mix differs from n=27k and is where the
+  # round-5 wall/flop question actually lives
+  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r04_k48.json" \
+    timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
+  stamp "profile k48 rc=$?"
   # 3. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
