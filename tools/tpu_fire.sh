@@ -50,13 +50,23 @@ SLU_BENCH_ASSUME_LIVE=1 timeout 1500 python "$repo/bench.py" \
   > "$bench_tmp" 2>> "$log"
 rc=$?
 cat "$bench_tmp" >> "$log"
-if grep -q '"cpu_fallback": false' "$bench_tmp"; then
-  mv "$bench_tmp" "$bench_out"
+if grep -q '"cpu_fallback": false' "$bench_tmp" \
+   && ! grep -q '"promoted": true' "$bench_tmp"; then
+  # a genuine on-hardware line: bench stamps the contract line itself
+  # (ts/desc/commit) and self-writes it to the record file, reporting
+  # the save outcome in-band (`hw_record_saved`).  The mv remains for
+  # the dryrun path (CPU-pinned bench never self-writes) and for a
+  # failed in-process save — the stamped stdout line is itself a
+  # valid promotable record, so installing it loses nothing
+  if [ "${SLU_FIRE_DRYRUN:-0}" = "1" ] \
+     || ! grep -q '"hw_record_saved": true' "$bench_tmp"; then
+    mv "$bench_tmp" "$bench_out"
+  fi
   stamp "bench primary rc=$rc -> $bench_out"
 else
-  rm -f "$bench_tmp"
   stamp "bench primary rc=$rc fell back/failed; kept prior $bench_out"
 fi
+rm -f "$bench_tmp"
 
 # 2. Hardware smoke — the complex-path cleanliness measurement that
 #    decides the real-view codec gate (TPU_SMOKE.jsonl), Pallas
