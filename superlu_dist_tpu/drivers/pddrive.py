@@ -103,7 +103,11 @@ def main(argv=None) -> int:
     # accelerator trade is measured WORSE there.
     from ..utils.platform import (apply_accel_amalg_defaults,
                                   complex_needs_cpu)
-    if args.backend != "host" and not complex_needs_cpu(np.dtype(fdt)):
+    # pair_capable mirrors the run mode: the fused solver has no pair
+    # storage, so under --fused a complex system reroutes to CPU even
+    # with SLU_COMPLEX_PAIR=1 and must not get the accelerator trade
+    if args.backend != "host" and not complex_needs_cpu(
+            np.dtype(fdt), pair_capable=not args.fused):
         import jax
         try:
             accel = jax.default_backend() != "cpu"
@@ -187,7 +191,9 @@ def _solve_fused(a, b, opts, stats):
         # two differently-precisioned factorizations
         from ..utils.platform import complex_device_gate
         fdt = effective_factor_dtype(a.dtype, dtype_name)
-        with complex_device_gate(fdt, a.dtype):
+        # pair_capable=False: the fused program builds native-complex
+        # storage — SLU_COMPLEX_PAIR must not lift its CPU gate
+        with complex_device_gate(fdt, a.dtype, pair_capable=False):
             step = make_fused_solver(plan, dtype=fdt)
             with stats.timer(phase):
                 x, berr, steps, tiny, _ = step(jnp.asarray(a.data),

@@ -266,11 +266,18 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
             out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(hu[:w, :w])
         return out
     sched = lu.device_lu.schedule
+
+    def _np_decode(flat):
+        # pair-stored factors ((2, N) real planes) decode to complex
+        # on the host for this numpy walk
+        flat = np.asarray(flat)
+        return flat[0] + 1j * flat[1] if flat.ndim == 2 else flat
+
     panels = getattr(lu.device_lu, "panels", None)
     if panels is not None:
         # staged factors: per-group local U flats, offset 0
         for g, p in zip(sched.groups, panels):
-            Ug = np.asarray(p[1])
+            Ug = _np_decode(p[1])
             for bg, s in zip(g.sup_pos, g.sup_ids):
                 b = int(bg)     # staged is single-device (d == 0)
                 panel = Ug[b * g.wb * g.mb:(b + 1) * g.wb
@@ -279,7 +286,7 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
                 out[int(xsup[s]):int(xsup[s]) + w] = \
                     np.diagonal(panel)[:w]
         return out
-    U_flat = np.asarray(lu.device_lu.U_flat)
+    U_flat = _np_decode(lu.device_lu.U_flat)
     # dist flats are the ndev-concatenated device-major slabs; the
     # single-device case is ndev=1 of the same layout
     U_total = U_flat.size // sched.ndev
@@ -308,8 +315,10 @@ def query_space(lu: LUFactorization) -> dict:
         if hasattr(d, "held_bytes"):
             held = d.held_bytes()
         else:
-            held = (d.L_flat.size + d.U_flat.size + d.Li_flat.size
-                    + d.Ui_flat.size) * itemsize
+            # nbytes counts pair storage ((2, N) real planes, same
+            # bytes as N complex) and native storage identically
+            held = (d.L_flat.nbytes + d.U_flat.nbytes
+                    + d.Li_flat.nbytes + d.Ui_flat.nbytes)
     return {"lu_nnz": nnz, "lu_bytes": nnz * itemsize,
             "held_bytes": int(held)}
 

@@ -855,6 +855,38 @@ def _real_dtype(dtype: np.dtype):
     return np.dtype(dtype.char.lower()) if dtype.kind == "c" else dtype
 
 
+def _pair_mode(dtype) -> bool:
+    """Factor complex systems on stacked real/imag planes
+    (ops/pair_lu, _factor_group_impl_pair) instead of native complex
+    storage — the lowering detour for platforms whose base-level
+    complex compilation is broken (utils/platform.py)."""
+    from ..utils.platform import complex_pair_enabled
+    return np.dtype(dtype).kind == "c" and complex_pair_enabled()
+
+
+def _pair_encode_vals(scaled_vals, dtype) -> np.ndarray:
+    """Host-side complex→plane encoding of the numeric input: the
+    device program must receive real operands (a complex→real
+    extraction inside the program would reintroduce the broken
+    lowering this mode exists to avoid)."""
+    rdt = _real_dtype(np.dtype(dtype))
+    v = np.asarray(scaled_vals).astype(np.dtype(dtype))
+    return np.stack([v.real.astype(rdt), v.imag.astype(rdt)])
+
+
+def _pair_encode_rhs(bb: np.ndarray) -> np.ndarray:
+    """Host-side rhs encoding for the sweeps' real-view codec: real
+    and imaginary halves concatenated along the rhs axis (_enc's
+    layout, produced outside the program)."""
+    return np.concatenate([bb.real, bb.imag], axis=-1)
+
+
+def _pair_decode_sol(X: np.ndarray, xdt) -> np.ndarray:
+    """Invert _pair_encode_rhs on the solved X (host side)."""
+    h = X.shape[-1] // 2
+    return (X[..., :h] + 1j * X[..., h:]).astype(xdt)
+
+
 # --------------------------------------------------------------------
 # per-group bodies — ONE implementation serves the single-device jit
 # path (axis=None) and the shard_map distributed path (axis='z'): the
@@ -973,7 +1005,13 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        axis: Optional[str] = None,
                        gather: bool = True, coop: bool = False,
                        ndev: int = 1, pos_idx=None, cp: int = 0,
-                       tp: int = 0):
+                       tp: int = 0, pair: bool = False):
+    if pair:
+        return _factor_group_impl_pair(
+            vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
+            nzero, thresh, a_src, a_dst, one_dst, ea_blocks, upd_off,
+            L_off, U_off, Li_off, Ui_off, mb=mb, wb=wb, n_pad=n_pad,
+            ea_meta=ea_meta, axis=axis, coop=coop)
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     sharded = coop and axis is not None and cp > 0
@@ -1060,6 +1098,76 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
         else:
             off = upd_off
         upd_buf = jax.lax.dynamic_update_slice(upd_buf, upd, (off,))
+    return (upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
+            tiny + tiny_g, nzero + nzero_g)
+
+
+def _factor_group_impl_pair(vals, upd_buf, L_flat, U_flat, Li_flat,
+                            Ui_flat, tiny, nzero, thresh, a_src,
+                            a_dst, one_dst, ea_blocks, upd_off, L_off,
+                            U_off, Li_off, Ui_off, *, mb: int,
+                            wb: int, n_pad: int, ea_meta: tuple = (),
+                            axis: Optional[str] = None,
+                            coop: bool = False):
+    """_factor_group_impl on stacked real/imag planes (ops/pair_lu):
+    the complex-factorization body for platforms whose native complex
+    lowering is broken (utils/platform.py gate).  Every flat is
+    (2, N) REAL — exactly the solve-storage layout _solve_view
+    produces — so the factor's outputs feed the existing sweeps with
+    no re-encoding.  Assembly and extend-add are structural
+    (plane-wise, vmapped over the plane axis, which preserves the
+    scatter uniqueness/sortedness promises per plane); only the dense
+    kernels carry pair arithmetic.  Single-device only: complex on a
+    TPU mesh stays gated (parallel/factor_dist.py policy note)."""
+    if axis is not None or coop:
+        raise NotImplementedError(
+            "pair-mode complex factorization is single-device; "
+            "complex mesh execution stays on the CPU backend "
+            "(utils/platform.complex_mesh_blocked)")
+    from .pair_lu import (partial_lu_pair_batch, unit_lower_inverse_pair,
+                          upper_inverse_pair)
+    rdt = L_flat.dtype
+    ncols = mb
+    one_pl = jnp.stack([jnp.ones((), rdt), jnp.zeros((), rdt)])
+
+    def assemble(f, v, o):
+        f = f.at[a_dst].add(v[a_src], mode="drop",
+                            unique_indices=True,
+                            indices_are_sorted=True)
+        return f.at[one_dst].set(o, mode="drop", unique_indices=True)
+
+    F = jax.vmap(assemble)(jnp.zeros((2, n_pad * mb * ncols), rdt),
+                           vals, one_pl)
+    F = jax.vmap(lambda f, u: _ea_add(
+        f, u, ea_blocks, ea_meta, mb=mb, n_pad=n_pad,
+        ncols=ncols))(F, upd_buf)
+    F = F.reshape(2, n_pad, mb, ncols)
+    F, tiny_g, nzero_g = partial_lu_pair_batch(F, thresh, wb=wb)
+    Lsrc, Usrc = F[:, :, :, :wb], F[:, :, :wb, :]
+
+    rows = jnp.arange(mb)[:, None]
+    colsw = jnp.arange(wb)[None, :]
+    Lpanel = jnp.where(rows > colsw, Lsrc, 0)
+    Lpanel = Lpanel.at[0].add(                 # unit diagonal, plane 0
+        jnp.where(rows == colsw, jnp.ones((), rdt), 0))
+    Upanel = jnp.where(colsw.T <= jnp.arange(mb)[None, :], Usrc, 0)
+    Li = unit_lower_inverse_pair(Lpanel[:, :, :wb, :])
+    Ui = upper_inverse_pair(Upanel[:, :, :, :wb])
+
+    z = jnp.zeros((), jnp.int32)
+    L_flat = jax.lax.dynamic_update_slice(
+        L_flat, Lpanel.reshape(2, -1), (z, L_off))
+    U_flat = jax.lax.dynamic_update_slice(
+        U_flat, Upanel.reshape(2, -1), (z, U_off))
+    Li_flat = jax.lax.dynamic_update_slice(
+        Li_flat, Li.reshape(2, -1), (z, Li_off))
+    Ui_flat = jax.lax.dynamic_update_slice(
+        Ui_flat, Ui.reshape(2, -1), (z, Ui_off))
+    if mb > wb:
+        upd_buf = jax.lax.dynamic_update_slice(
+            upd_buf, F[:, :, wb:, wb:].reshape(2, -1),
+            (jnp.zeros((), getattr(upd_off, "dtype", jnp.int32)),
+             upd_off))
     return (upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
             tiny + tiny_g, nzero + nzero_g)
 
@@ -1268,27 +1376,30 @@ def staged_enabled(sched) -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("mb", "wb", "n_pad", "ea_meta"),
+                   static_argnames=("mb", "wb", "n_pad", "ea_meta",
+                                    "pair"),
                    donate_argnums=(0,))
 def _staged_factor_group(upd_buf, vals, thresh, a_src, a_dst, one_dst,
                          ea_blocks, upd_off, *, mb: int, wb: int,
-                         n_pad: int, ea_meta: tuple):
+                         n_pad: int, ea_meta: tuple,
+                         pair: bool = False):
     """One factor group as its own program: group-LOCAL panel outputs
     (offset 0 into exact-size flats) instead of writes into the global
     slabs; `upd_buf` is donated so the extend-add buffer streams
     through the group sequence in place."""
     dtype = upd_buf.dtype
+    lead = (2,) if pair else ()
     z32 = jnp.zeros((), jnp.int32)
     with jax.default_matmul_precision("float32"):
         return _factor_group_impl(
             vals, upd_buf,
-            jnp.zeros(n_pad * mb * wb, dtype),
-            jnp.zeros(n_pad * wb * mb, dtype),
-            jnp.zeros(n_pad * wb * wb, dtype),
-            jnp.zeros(n_pad * wb * wb, dtype),
+            jnp.zeros(lead + (n_pad * mb * wb,), dtype),
+            jnp.zeros(lead + (n_pad * wb * mb,), dtype),
+            jnp.zeros(lead + (n_pad * wb * wb,), dtype),
+            jnp.zeros(lead + (n_pad * wb * wb,), dtype),
             z32, z32, thresh, a_src, a_dst, one_dst, ea_blocks,
             upd_off, z32, z32, z32, z32,
-            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta)
+            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta, pair=pair)
 
 
 @functools.partial(jax.jit,
@@ -1314,16 +1425,30 @@ def _vals_ext(v, dtype_str: str):
     return jnp.concatenate([v.astype(dtype), jnp.zeros(1, dtype)])
 
 
-def _staged_factor_run(sched, vals, thresh_np, dtype):
+@functools.partial(jax.jit, static_argnames=("dtype_str",))
+def _vals_ext_pair(v, dtype_str: str):
+    dtype = np.dtype(dtype_str)
+    return jnp.concatenate([v.astype(dtype), jnp.zeros((2, 1), dtype)],
+                           axis=1)
+
+
+def _staged_factor_run(sched, vals, thresh_np, dtype,
+                       pair: bool = False):
     """Python-dispatched group loop: returns (panels, tiny, nzero)
     where panels[i] = (L, U, Li, Ui) group-local flats for group i and
     the counters are device scalars (no per-group host sync — the
-    dispatch loop must stay ahead of device execution)."""
+    dispatch loop must stay ahead of device execution).  In pair mode
+    `vals` arrives host-encoded as (2, nnz) real planes and every
+    buffer carries the leading plane axis."""
     dtype = np.dtype(dtype)
     rdt = _real_dtype(dtype)
-    vals_ext = _vals_ext(vals, dtype.str)
+    if pair:
+        vals_ext = _vals_ext_pair(vals, rdt.str)
+        upd_buf = jnp.zeros((2, sched.upd_total + 1), rdt)
+    else:
+        vals_ext = _vals_ext(vals, dtype.str)
+        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
     thresh = jnp.asarray(thresh_np, dtype=rdt)
-    upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
     panels = []
     tiny = nzero = jnp.zeros((), jnp.int32)
     for g in sched.groups:
@@ -1331,7 +1456,8 @@ def _staged_factor_run(sched, vals, thresh_np, dtype):
         (upd_buf, L, U, Li, Ui, t, z) = _staged_factor_group(
             upd_buf, vals_ext, thresh, a_src, a_dst, one_dst,
             ea_blocks, jnp.asarray(g.upd_off_global, jnp.int64),
-            mb=g.mb, wb=g.wb, n_pad=g.n_loc, ea_meta=g.ea_meta)
+            mb=g.mb, wb=g.wb, n_pad=g.n_loc, ea_meta=g.ea_meta,
+            pair=pair)
         panels.append((L, U, Li, Ui))
         tiny = tiny + t
         nzero = nzero + z
@@ -1339,16 +1465,25 @@ def _staged_factor_run(sched, vals, thresh_np, dtype):
     return panels, int(tiny), int(nzero)
 
 
-def _staged_sweeps(sched, panels, bf, dtype, trans: bool):
+def _staged_sweeps(sched, panels, bf, dtype, trans: bool,
+                   pair: bool = False):
     """Forward+backward sweeps over the staged panels.  `bf` is the
-    RHS in factor ordering, shape (n, nrhs); returns X[:n]."""
+    RHS in factor ordering, shape (n, nrhs); returns X[:n].  In pair
+    mode (plane-stored panels) `bf` arrives already real-view encoded
+    (n, 2·nrhs) and the result returns encoded — the caller decodes on
+    the host, so the program stays complex-free."""
     dtype = np.dtype(dtype)
-    xdt = jnp.promote_types(dtype, bf.dtype)
-    cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
     n = sched.n
-    X = jnp.zeros((n + 1, bf.shape[1]), xdt)
-    X = X.at[:n, :].set(bf.astype(xdt))
-    X = _enc_jit(X, cplx)
+    if pair:
+        cplx = True
+        X = jnp.zeros((n + 1, bf.shape[1]), bf.dtype)
+        X = X.at[:n, :].set(bf)
+    else:
+        xdt = jnp.promote_types(dtype, bf.dtype)
+        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+        X = jnp.zeros((n + 1, bf.shape[1]), xdt)
+        X = X.at[:n, :].set(bf.astype(xdt))
+        X = _enc_jit(X, cplx)
     # trans solves Mᵀ = Uᵀ·Lᵀ: forward on Uᵀ panels, backward on Lᵀ
     fidx, fiidx = (1, 3) if trans else (0, 2)   # U,Ui / L,Li
     bidx, biidx = (0, 2) if trans else (1, 3)
@@ -1363,6 +1498,8 @@ def _staged_sweeps(sched, panels, bf, dtype, trans: bool):
         X = _staged_sweep_group(X, p[bidx], p[biidx], ci, si,
                                 mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                                 cplx=cplx, kind=bkind)
+    if pair:
+        return X[:sched.n]          # still encoded; host decodes
     return _dec_jit(X, cplx)[:sched.n]
 
 
@@ -1407,20 +1544,36 @@ class StagedLU:
     tiny_pivots: int
 
     def held_bytes(self) -> int:
-        return sum(int(a.size) * np.dtype(self.dtype).itemsize
-                   for p in self.panels for a in p)
+        # pair-stored panels are real arrays of 2× the element count;
+        # nbytes counts either storage correctly
+        return sum(int(a.nbytes) for p in self.panels for a in p)
 
 
-def _phase_fns(sched, dtype, thresh_np):
+def _lu_is_pair(lu) -> bool:
+    """Factors stored as stacked real/imag planes?  (2, N) flats /
+    panels discriminate from the native 1-D flat storage."""
+    if isinstance(lu, StagedLU):
+        return bool(lu.panels) and lu.panels[0][0].ndim == 2
+    return lu.L_flat.ndim == 2
+
+
+def _phase_fns(sched, dtype, thresh_np, pair=None):
     """Cached whole-phase jitted programs for a (schedule, dtype):
     factor, solve and transpose-solve each compile ONCE and run as a
     single dispatch (vs one dispatch per group).  Backed by
     factor_dist's shared _factor_loop/_solve_loop so every execution
-    mode runs the same group-loop code."""
+    mode runs the same group-loop code.
+
+    `pair` selects plane storage (default: the env-resolved
+    _pair_mode).  Solve-time callers pass the HANDLE's actual storage
+    (_lu_is_pair) so a factorization held across an env change still
+    gets a program matching its flats."""
     cache = getattr(sched, "_phase_fns", None)
     if cache is None:
         cache = sched._phase_fns = {}
-    key = (np.dtype(dtype).str, float(thresh_np))
+    if pair is None:
+        pair = _pair_mode(dtype)
+    key = (np.dtype(dtype).str, float(thresh_np), pair)
     if key in cache:
         return cache[key]
     from ..parallel.factor_dist import _factor_loop, _solve_loop
@@ -1431,12 +1584,12 @@ def _phase_fns(sched, dtype, thresh_np):
     @jax.jit
     def factor_fn(vals):
         return _factor_loop(sched, vals, thresh_np, dtype, per_group,
-                            None)
+                            None, pair=pair)
 
     @functools.partial(jax.jit, static_argnames=("trans",))
     def solve_fn(L, U, Li, Ui, b, trans=False):
         return _solve_loop(sched, (L, U, Li, Ui), b, dtype, pairs,
-                           None, trans=trans)
+                           None, trans=trans, pair=pair)
 
     cache[key] = (factor_fn, solve_fn)
     return cache[key]
@@ -1446,17 +1599,22 @@ def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
                      dtype=np.float64):
     sched = get_schedule(plan, 1)
     dtype = np.dtype(dtype)
+    pair = _pair_mode(dtype)
     if staged_enabled(sched):
+        vin = (_pair_encode_vals(scaled_vals, dtype) if pair
+               else np.asarray(scaled_vals))
         panels, tiny, nzero = _staged_factor_run(
-            sched, jnp.asarray(np.asarray(scaled_vals)),
-            _thresh_for(plan, dtype), dtype)
+            sched, jnp.asarray(vin),
+            _thresh_for(plan, dtype), dtype, pair=pair)
         lu = StagedLU(plan=plan, schedule=sched, dtype=dtype,
                       panels=panels, tiny_pivots=tiny)
     else:
         factor_fn, _ = _phase_fns(sched, dtype,
-                                  _thresh_for(plan, dtype))
+                                  _thresh_for(plan, dtype), pair=pair)
+        vin = (_pair_encode_vals(scaled_vals, dtype) if pair
+               else scaled_vals.astype(dtype))
         (L_flat, U_flat, Li_flat, Ui_flat, tiny,
-         nzero) = factor_fn(jnp.asarray(scaled_vals.astype(dtype)))
+         nzero) = factor_fn(jnp.asarray(vin))
         nzero = int(nzero)
         lu = DeviceLU(plan=plan, schedule=sched, dtype=dtype,
                       L_flat=L_flat, U_flat=U_flat,
@@ -1479,16 +1637,25 @@ def _solve_device_common(lu, b: np.ndarray, trans: bool):
     # promote rather than cast: a complex rhs against a real factor
     # must stay complex (matmuls promote; matches the host backend)
     xdt = np.promote_types(lu.dtype, bb.dtype)
+    # pair-stored factors (complex planes, _pair_mode): the rhs is
+    # real-view encoded on the host so the compiled sweep contains no
+    # complex ops at all (the whole point of the storage)
+    pair = _lu_is_pair(lu)
+    bin_ = (_pair_encode_rhs(bb.astype(xdt)) if pair
+            else bb.astype(xdt))
     if isinstance(lu, StagedLU):
         X = _staged_sweeps(lu.schedule, lu.panels,
-                           jnp.asarray(bb.astype(xdt)), lu.dtype,
-                           trans)
+                           jnp.asarray(bin_), lu.dtype, trans,
+                           pair=pair)
     else:
         _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
-                                 _thresh_for(lu.plan, lu.dtype))
+                                 _thresh_for(lu.plan, lu.dtype),
+                                 pair=pair)
         X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
-                     jnp.asarray(bb.astype(xdt)), trans=trans)
+                     jnp.asarray(bin_), trans=trans)
     out = np.asarray(X)
+    if pair:
+        out = _pair_decode_sol(out, xdt)
     return out[:, 0] if squeeze else out
 
 

@@ -76,15 +76,28 @@ def _regroup(dsched, idx_flat, per):
 
 
 @_hi_prec
-def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
-    """Shared factorization group loop (runs inside shard_map)."""
-    thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
-    vals = jnp.concatenate([vals.astype(dtype), jnp.zeros(1, dtype)])
-    upd_buf = jnp.zeros(dsched.upd_total + 1, dtype)
-    L_flat = jnp.zeros(dsched.L_total, dtype)
-    U_flat = jnp.zeros(dsched.U_total, dtype)
-    Li_flat = jnp.zeros(dsched.Li_total, dtype)
-    Ui_flat = jnp.zeros(dsched.Ui_total, dtype)
+def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis,
+                 pair: bool = False):
+    """Shared factorization group loop (runs inside shard_map).  In
+    pair mode (complex on stacked real/imag planes,
+    batched._factor_group_impl_pair) `vals` arrives host-encoded as
+    (2, nnz) real planes and every slab carries the leading plane
+    axis — the compiled program contains no complex ops."""
+    rdt = _real_dtype(dtype)
+    thresh = jnp.asarray(thresh_np, dtype=rdt)
+    if pair:
+        sdt, lead = rdt, (2,)
+        vals = jnp.concatenate(
+            [vals.astype(rdt), jnp.zeros((2, 1), rdt)], axis=1)
+    else:
+        sdt, lead = dtype, ()
+        vals = jnp.concatenate([vals.astype(dtype),
+                                jnp.zeros(1, dtype)])
+    upd_buf = jnp.zeros(lead + (dsched.upd_total + 1,), sdt)
+    L_flat = jnp.zeros(lead + (dsched.L_total,), sdt)
+    U_flat = jnp.zeros(lead + (dsched.U_total,), sdt)
+    Li_flat = jnp.zeros(lead + (dsched.Li_total,), sdt)
+    Ui_flat = jnp.zeros(lead + (dsched.Ui_total,), sdt)
     tiny = jnp.zeros((), jnp.int32)
     nzero = jnp.zeros((), jnp.int32)
     for g, idx in zip(dsched.groups, per_group):
@@ -98,13 +111,14 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis):
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
             ea_meta=g.ea_meta,
             axis=axis, gather=g.needs_gather, coop=g.coop,
-            ndev=dsched.ndev, pos_idx=pos_idx, cp=g.cp, tp=g.tp)
+            ndev=dsched.ndev, pos_idx=pos_idx, cp=g.cp, tp=g.tp,
+            pair=pair)
     return (L_flat, U_flat, Li_flat, Ui_flat, tiny, nzero)
 
 
 @_hi_prec
 def _solve_loop(dsched, flats, b, dtype, per_group, axis,
-                trans: bool):
+                trans: bool, pair: bool = False):
     """Shared triangular-sweep loop (runs inside shard_map).
     `per_group` entries are (col_idx, struct_idx) pairs.
 
@@ -127,13 +141,24 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
     L_flat, U_flat, Li_flat, Ui_flat = (
         _solve_view(f) for f in flats)
     n = dsched.n
-    xdt = jnp.promote_types(dtype, b.dtype)
-    cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
-    X = jnp.zeros((n + 1, b.shape[1]), xdt)
-    X = X.at[:n, :].set(b.astype(xdt))
-    # complex systems sweep on the real-view storage (see the codec
-    # note at batched._dec): gathers/scatters/psums stay real
-    X = _enc(X, cplx)
+    if pair:
+        # pair-stored factors: flats are already (2, N) planes and b
+        # arrives real-view encoded (n, 2R) from the host — the whole
+        # program is complex-free, including the prologue/epilogue
+        # (on the gated platform even the one-time extraction would
+        # reintroduce the broken lowering)
+        cplx = True
+        X = jnp.zeros((n + 1, b.shape[1]), b.dtype)
+        X = X.at[:n, :].set(b)
+    else:
+        xdt = jnp.promote_types(dtype, b.dtype)
+        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+        X = jnp.zeros((n + 1, b.shape[1]), xdt)
+        X = X.at[:n, :].set(b.astype(xdt))
+        # complex systems sweep on the real-view storage (see the
+        # codec note at batched._dec): gathers/scatters/psums stay
+        # real
+        X = _enc(X, cplx)
     Xs = X                       # last reconciled snapshot (axis mode)
 
     def sync(X, Xs):
@@ -166,6 +191,8 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
                    mb=g.mb, wb=g.wb, n_pad=g.n_loc, cplx=cplx)
     if axis is not None:
         X, _ = sync(X, Xs)       # replicate the final solution
+    if pair:
+        return X[:n]             # still encoded; host decodes
     return _dec(X, cplx)[:n]
 
 

@@ -32,12 +32,32 @@ import os
 import numpy as np
 
 
-def complex_needs_cpu(dtype) -> bool:
+def complex_pair_enabled() -> bool:
+    """Real-pair complex lowering (ops/pair_lu +
+    batched._factor_group_impl_pair): the single-device complex
+    factor/solve runs on stacked real/imag planes, so the compiled
+    program contains NO complex ops and dodges the base-level complex
+    lowering wedge entirely.  SLU_COMPLEX_PAIR=1 opts in (the path is
+    oracle-verified on CPU; tools/tpu_smoke.py's `c128_pair_solve`
+    check is the hardware certification lever — flip the default here
+    once a window certifies it clean on-chip)."""
+    return os.environ.get("SLU_COMPLEX_PAIR", "0") == "1"
+
+
+def complex_needs_cpu(dtype, pair_capable: bool = True) -> bool:
     """True when `dtype` is complex and the default backend is a TPU
-    whose complex lowering is gated off (see module docstring)."""
+    whose complex lowering is gated off (see module docstring).
+    Pair mode lifts the gate — its programs are all-real, so the
+    broken native-complex lowering is never exercised — but only for
+    callers that actually implement pair storage; a path that still
+    builds native-complex programs (the fused one-program solver)
+    passes pair_capable=False so the lift cannot route it into the
+    measured compile wedge."""
     if not np.issubdtype(np.dtype(dtype), np.complexfloating):
         return False
     if os.environ.get("SLU_COMPLEX_TPU", "0") == "1":
+        return False
+    if pair_capable and complex_pair_enabled():
         return False
     import jax
     return jax.default_backend() == "tpu"
@@ -103,11 +123,14 @@ def complex_mesh_blocked(dtype, mesh) -> bool:
 
 
 @contextlib.contextmanager
-def complex_device_gate(*dtypes):
+def complex_device_gate(*dtypes, pair_capable: bool = True):
     """Context manager: place jitted programs on the host CPU backend
     when any of `dtypes` trips complex_needs_cpu; no-op otherwise.
-    Yields True when the gate engaged (for logging/telemetry)."""
-    if any(complex_needs_cpu(dt) for dt in dtypes):
+    Yields True when the gate engaged (for logging/telemetry).
+    pair_capable=False for callers whose programs cannot use pair
+    storage (see complex_needs_cpu)."""
+    if any(complex_needs_cpu(dt, pair_capable=pair_capable)
+           for dt in dtypes):
         import jax
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             yield True

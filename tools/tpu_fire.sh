@@ -69,12 +69,14 @@ fi
 rm -f "$bench_tmp"
 
 # 2. Hardware smoke — the complex-path cleanliness measurement that
-#    decides the real-view codec gate (TPU_SMOKE.jsonl), Pallas
-#    compile.  240 s per check: generous for the measured ~92 s
-#    compile class, and a repeat of the known c128 wedge costs 4 min
-#    of the window, not the full default budget.
+#    decides the real-view codec gate (TPU_SMOKE.jsonl), the pair
+#    lowering certification (c128_pair_*), Pallas compile.  240 s per
+#    check: generous for the measured ~92 s compile class, and a
+#    repeat of the known c128 wedge costs 4 min of the window, not
+#    the full default budget.  Outer 2100 s covers probe (120) + 6
+#    checks x 240 + teardown slack.
 SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
-  timeout 1500 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
+  timeout 2100 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
 stamp "smoke rc=$? -> $smoke_out"
 
 # 3+4 run on hardware only: the sweep's n=262k config uses the fused

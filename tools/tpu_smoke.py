@@ -9,9 +9,9 @@ gating it by platform, VERDICT round-1 weak #8), the f32+IR fused
 step, and the Pallas kernel compile.
 
 Isolation: each check runs in its OWN subprocess with a per-check
-timeout (SLU_SMOKE_CHECK_TIMEOUT, default 330 s; the platform probe
-is capped at 120 s, so probe + 4 checks = 1440 s fits inside
-tpu_fire.sh's outer 1500 s).  The first live window
+timeout (SLU_SMOKE_CHECK_TIMEOUT; tpu_fire.sh runs 240 s per check,
+probe capped at 120 s, so probe + 6 checks = 1560 s fits inside its
+outer 2100 s).  The first live window
 (2026-08-01) showed why: the c128 fused program wedged on the tunnel
 for >23 min — while the same-shape f32 program took 92 s — and the
 single-process smoke burned its whole budget inside that one check,
@@ -47,8 +47,16 @@ import time
 #     green is the signal to lift the gate's default.
 #   c128_solve — the USER path: gssvx on a complex system under the
 #     gate; must pass (placed on CPU) even on broken-platform windows.
-CHECKS = ("f32_ir_solve", "c128_kernel", "c128_solve",
-          "pallas_compile")
+#   c128_pair_kernel / c128_pair_solve — the real-pair lowering detour
+#     (ops/pair_lu, VERDICT r4 item 6): the same complex math compiled
+#     as an ALL-REAL program (stacked real/imag planes).  pair_kernel
+#     is the raw probe (jit below the gate); pair_solve is gssvx with
+#     SLU_COMPLEX_PAIR=1 — a clean on-TPU pass at matching residual is
+#     the certification to flip complex_pair_enabled's default and run
+#     complex ON the accelerator; a wedge is the evidence that the
+#     CPU gate must stand.
+CHECKS = ("f32_ir_solve", "c128_kernel", "c128_pair_kernel",
+          "c128_pair_solve", "c128_solve", "pallas_compile")
 
 
 def _build_matrix():
@@ -92,6 +100,48 @@ def run_check(name):
         # quick soundness: LU of the leading block reproduces it
         return dict(finite=bool(np.all(np.isfinite(np.asarray(Fp)))),
                     gemm_finite=bool(np.all(np.isfinite(np.asarray(g)))))
+
+    if name == "c128_pair_kernel":
+        # the c128_kernel program re-expressed on real/imag planes
+        # (ops/pair_lu): one jitted pair partial-LU + one pair GEMM —
+        # an all-real program, so the broken native-complex lowering
+        # is never exercised.  Green here + green pair_solve = lift
+        # the complex gate via SLU_COMPLEX_PAIR.
+        import jax
+        from superlu_dist_tpu.ops import pair_lu
+        rng = np.random.default_rng(3)
+        F = (rng.standard_normal((48, 48))
+             + 1j * rng.standard_normal((48, 48)))
+        F += np.diag(np.full(48, 16.0 + 0j))
+        Fp = pair_lu.encode(jnp.asarray(F, dtype=jnp.complex128))
+        Fo, tiny, nzero = jax.jit(
+            lambda m: pair_lu.partial_lu_pair(m, 1e-30, wb=24))(Fp)
+        Fo.block_until_ready()
+        g = jax.jit(pair_lu.pmatmul)(Fp, Fp)
+        g.block_until_ready()
+        return dict(finite=bool(np.all(np.isfinite(np.asarray(Fo)))),
+                    gemm_finite=bool(np.all(np.isfinite(np.asarray(g)))))
+
+    if name == "c128_pair_solve":
+        # the complex USER path with the pair lowering opted in: the
+        # gate lifts (complex_needs_cpu False), gssvx factors/solves
+        # on the default (accelerator) backend with plane storage
+        import scipy.sparse as sp
+        os.environ["SLU_COMPLEX_PAIR"] = "1"
+        from superlu_dist_tpu.utils.platform import complex_needs_cpu
+        ar = _build_matrix()
+        rng = np.random.default_rng(1)
+        az = ar.to_scipy().astype(np.complex128) \
+            + 1j * sp.diags(rng.standard_normal(ar.n) * 0.1)
+        az = csr_from_scipy(az.tocsr())
+        xtrue = rng.standard_normal(az.n) + 1j * rng.standard_normal(az.n)
+        gated = bool(complex_needs_cpu(np.complex128))
+        x, lu, st = gssvx(Options(), az, az.to_scipy() @ xtrue)
+        relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
+        from superlu_dist_tpu.ops.batched import _lu_is_pair
+        return dict(relerr=relerr, berr=st.berr, gated_to_cpu=gated,
+                    pair_storage=bool(lu.device_lu is not None
+                                      and _lu_is_pair(lu.device_lu)))
 
     if name == "c128_solve":
         # the complex USER path end-to-end: gssvx under the platform
