@@ -73,6 +73,9 @@ class LocalComm:
         assert payload is not None
         return payload
 
+    def alltoall(self, payloads: List[bytes]) -> List[bytes]:
+        return [payloads[0]]
+
 
 class JaxProcessComm:
     """PlanComm over the JAX process group (multihost_utils) — the
@@ -104,6 +107,15 @@ class JaxProcessComm:
         from .multihost import _broadcast_bytes
         return _broadcast_bytes(payload if self.rank == 0 else b"",
                                 self.rank == 0)
+
+    def alltoall(self, payloads: List[bytes]) -> List[bytes]:
+        # transport limitation: the process group offers allgather
+        # only, so the exchange ships every pairwise payload to every
+        # rank and each keeps its own column — RETAINED memory is the
+        # per-rank share (the algorithmic claim), transient wire is
+        # O(total).  An MPI_Alltoallv transport slots in here.
+        parts = self.allgather(pickle.dumps(payloads))
+        return [pickle.loads(p)[self.rank] for p in parts]
 
 
 def default_comm():
@@ -212,6 +224,11 @@ def plan_factorization_dist(fst_row: int, indptr_loc, indices_loc,
     regroups the same stage arithmetic (see _equilibrate_dist and
     plan/psymbfact.py for the two stages whose data flow actually
     changes); divergence would be a bug and is pinned by test.
+    EXCEPTION: ColPerm.PARMETIS with P > 1 runs the distributed
+    multilevel ND (parallel/ordering_dist.py) — a DIFFERENT ordering
+    of the same quality class, exactly as the reference's
+    get_perm_c_parmetis differs from get_perm_c(METIS); all ranks
+    still return one identical plan (pinned by test).
 
     options.autotune is honored the same way plan_factorization
     honors it (bucket refit from the finished plan — deterministic,
@@ -300,19 +317,30 @@ def plan_factorization_dist(fst_row: int, indptr_loc, indices_loc,
                                           None)
             perm_r = _bcast0(comm, run_rowperm)
 
-    # [ColPerm] on pattern(Pr·A) — process 0 + broadcast (threaded ND
-    # tie-break determinism; get_perm_c is pattern-only, so ones stand
-    # in for the values process 0 does not hold)
+    # [ColPerm] on pattern(Pr·A).  ColPerm.PARMETIS with P > 1 runs
+    # the DISTRIBUTED multilevel ND (parallel/ordering_dist.py — the
+    # get_perm_c_parmetis slot: ordering computed from row-sliced
+    # pattern, work spread across ranks, O(n) collectives only);
+    # every other mode runs on process 0 and broadcasts (threaded ND
+    # tie-break determinism; get_perm_c is pattern-only, so ones
+    # stand in for the values process 0 does not hold)
     with stats.timer("COLPERM"):
-        def run_colperm():
-            a_rp = sp.coo_matrix(
-                (np.ones(len(coo_rows)),
-                 (perm_r[coo_rows], coo_cols)), shape=(n, n)).tocsr()
-            return colperm_mod.get_perm_c(
-                CSRMatrix(n, n, a_rp.indptr.astype(np.int64),
-                          a_rp.indices.astype(np.int64), a_rp.data),
-                options.col_perm, None, nd_threads=options.nd_threads)
-        perm_c = _bcast0(comm, run_colperm)
+        if options.col_perm == ColPerm.PARMETIS and comm.nproc > 1:
+            from .ordering_dist import colperm_dist
+            perm_c = colperm_dist(
+                comm, perm_r[fst_row + rows_loc], indices_loc, n,
+                nd_threads=options.nd_threads)
+        else:
+            def run_colperm():
+                a_rp = sp.coo_matrix(
+                    (np.ones(len(coo_rows)),
+                     (perm_r[coo_rows], coo_cols)), shape=(n, n)).tocsr()
+                return colperm_mod.get_perm_c(
+                    CSRMatrix(n, n, a_rp.indptr.astype(np.int64),
+                              a_rp.indices.astype(np.int64), a_rp.data),
+                    options.col_perm, None,
+                    nd_threads=options.nd_threads)
+            perm_c = _bcast0(comm, run_colperm)
 
     # [Etree → Symbfact → frontal → plan] — the shared back half
     # (plan.plan_from_perms): every stage there is deterministic from

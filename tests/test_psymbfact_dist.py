@@ -83,6 +83,13 @@ class ThreadComm:
         out = self._exchange(payload if self.rank == 0 else b"")
         return out[0]
 
+    def alltoall(self, payloads):
+        # true pairwise exchange: rank r receives payloads[r] from
+        # every rank (the spy records the full per-rank send list, so
+        # wire-accounting tests can sum the real sent bytes)
+        out = self._exchange(list(payloads))
+        return [out[r][self.rank] for r in range(self.nproc)]
+
 
 def _slices_from_cuts(a: CSRMatrix, cuts):
     """NRformat_loc row slices for the given cut positions (one
