@@ -305,6 +305,14 @@ def _zone_assignment(fp, ndev: int) -> np.ndarray:
     return zone
 
 
+def _level_merge_on() -> bool:
+    """SLU_LEVEL_MERGE=1: one padded group per etree level (see the
+    merge block in build_schedule).  Off by default — on CPU the
+    padded flops are real cost; the accelerator A/B decides."""
+    import os
+    return os.environ.get("SLU_LEVEL_MERGE", "0") == "1"
+
+
 def _coop_mb_min() -> int:
     """Minimum padded front size for cooperative (column-sharded)
     factorization; SLU_COOP_MB overrides, 0 disables."""
@@ -372,6 +380,10 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     coop_min = _coop_mb_min()
 
     sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
+    # actual slab row/col stride each front was WRITTEN with — its
+    # group's rb, which under SLU_LEVEL_MERGE can exceed the front's
+    # own bucket (fp.mb - fp.wb); parents must read with this stride
+    sup_slab_rb = np.zeros(fp.nsuper, dtype=np.int64)
     groups: List[GroupSpec] = []
     L_cur = U_cur = Li_cur = Ui_cur = 0
 
@@ -440,6 +452,21 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
         for s in sups:
             by_bucket.setdefault((int(fp.wb[s]), int(fp.mb[s])),
                                  []).append(int(s))
+        if _level_merge_on() and len(by_bucket) > 1:
+            # SLU_LEVEL_MERGE=1: collapse the level's bucket groups
+            # into ONE padded group — the latency-regime trade (fewer
+            # sequential group bodies on the device at the price of
+            # padded flops/slab; the tau/cap amalgamation's sibling
+            # lever, priced by the tools/tpu_fire.sh chain arms).
+            # The merged frame must hold every front's TRUE panel and
+            # struct extents: wb = max panel bucket, and rb = max
+            # STRUCT capacity (mb − wb per original bucket) — taking
+            # plain max(mb) could leave rb smaller than a wide-struct
+            # front needs.
+            wb_m = max(k[0] for k in by_bucket)
+            mb_m = wb_m + max(k[1] - k[0] for k in by_bucket)
+            by_bucket = {(wb_m, mb_m): [
+                s for k in sorted(by_bucket) for s in by_bucket[k]]}
         for (wb, mb), slist in sorted(by_bucket.items()):
             N = len(slist)
             rb = mb - wb
@@ -604,7 +631,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         rc = int(fp.r[c])
                         if rc == 0:
                             continue
-                        rbc = int(fp.mb[c]) - int(fp.wb[c])
+                        rbc = int(sup_slab_rb[c])
                         coff = sup_upd_off[c]
                         assert coff >= 0, "child scheduled after parent"
                         ps_row = _pad_pos(fp.ea_map[c], w, wb)
@@ -657,6 +684,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                     # (coop slabs: single owner-slot copy, bg = b)
                     sup_upd_off[s] = upd_off + (b if coop else bg) \
                         * rb * (tp if sharded else rb)
+                    sup_slab_rb[s] = rb
                     sup_dev[s] = d
                     sup_pos[pos_of[s]] = bg
             if sharded:
@@ -832,11 +860,12 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     cache = getattr(plan, "_batched_schedules", None)
     if cache is None:
         cache = plan._batched_schedules = {}
-    # the coop knobs participate in the key so a mid-process
-    # SLU_COOP_* change takes effect instead of hitting a stale entry
+    # the coop/merge knobs participate in the key so a mid-process
+    # SLU_COOP_*/SLU_LEVEL_MERGE change takes effect instead of
+    # hitting a stale entry
     key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block(),
                   _coop_solve_rotate())
-           if ndev > 1 else 0)
+           if ndev > 1 else 0, _level_merge_on())
     if key not in cache:
         cache[key] = build_schedule(plan, ndev)
     return cache[key]

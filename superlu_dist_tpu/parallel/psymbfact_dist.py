@@ -22,7 +22,11 @@ the reference's preprocessing does:
     broadcasts perm_r);
   * column ordering runs on process 0 and is broadcast — threaded ND
     may tie-break differently per invocation, and the SPMD contract
-    requires bit-identical schedules (multihost.py module docstring);
+    requires bit-identical schedules (multihost.py module docstring).
+    EXCEPTION: ColPerm.PARMETIS with P > 1 runs the DISTRIBUTED
+    multilevel ND instead (parallel/ordering_dist.py — per-rank
+    O(nnz/P + n) ordering wire, deterministic single-owner blocks,
+    identical perm on every rank by construction);
   * symbolic factorization is domain-distributed: the supernodal
     etree is cut by plan/psymbfact.py, each process computes its
     owned domains' struct lists, and one allgather of per-domain

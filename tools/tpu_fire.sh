@@ -94,9 +94,15 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   # and the n=110,592 step (warm executable from the sweep cache):
   # the scale regime's op mix differs from n=27k and is where the
   # round-5 wall/flop question actually lives
-  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r04_k48.json" \
+  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r05_k48.json" \
     timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
   stamp "profile k48 rc=$?"
+  # 2.7 Solve-only latency vs nrhs (1/8/64) on held factors — the
+  #     config-#5 / pdtest -s 64 regime (VERDICT r4 item 7); the
+  #     factor executable is warm from step 1's cache
+  timeout 1200 python "$repo/tools/solve_latency.py" \
+    >> "$repo/SOLVE_LATENCY.jsonl" 2>> "$log"
+  stamp "solve_latency rc=$?"
   # 3. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
@@ -139,6 +145,34 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     else
       cat "$ab_tmp" >> "$log"
       stamp "amalg tau=$tau cap=$cap rc=$rc fell back/failed; discarded"
+    fi
+    rm -f "$ab_tmp"
+  done
+  # 6. Sequential-chain arms (the latency-bound hypothesis, round-5
+  #    MFU attack).  SLU_DIAG_UNROLL fuses more rank-1 pivot steps
+  #    per XLA body (chain length wb/unroll per diag block);
+  #    SLU_LEVEL_MERGE collapses each etree level's bucket groups
+  #    into one padded group (35 -> ~11 sequential group bodies at
+  #    n=27k, paying padded flops).  Both are free on the MXU if the
+  #    step really is op-count-bound — only hardware can price them.
+  #    TPU_AB_CHAIN.jsonl format: each arm appends TWO lines — an
+  #    {"arm": ...} header, then the bench record — unlike
+  #    TPU_AB_TAU.jsonl's bare records (tau arms self-annotate in
+  #    their desc; these env knobs don't reach the desc string).
+  for arm in "SLU_DIAG_UNROLL=16" "SLU_DIAG_UNROLL=32" \
+             "SLU_LEVEL_MERGE=1" \
+             "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32"; do
+    ab_tmp=$(mktemp)
+    env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
+      timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
+    rc=$?
+    if grep -q '"cpu_fallback": false' "$ab_tmp"; then
+      { printf '{"arm": "%s"}\n' "$arm"; cat "$ab_tmp"; } \
+        >> "$repo/TPU_AB_CHAIN.jsonl"
+      stamp "chain arm [$arm] rc=$rc (recorded)"
+    else
+      cat "$ab_tmp" >> "$log"
+      stamp "chain arm [$arm] rc=$rc fell back/failed; discarded"
     fi
     rm -f "$ab_tmp"
   done

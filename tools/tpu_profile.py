@@ -6,7 +6,7 @@ A/B could only price one lever blind; the trace says WHERE the step's
 wall actually goes (per-op device time, gaps, transfers), which is the
 round-5 optimization starting point.  Raw traces are big and stay in
 the gitignored .tpu_trace/ dir; the committed artifact is
-TPU_PROFILE_r04.json — per-plane top events by total duration.
+TPU_PROFILE_r05.json — per-plane top events by total duration.
 
 Run by tpu_fire.sh (step 6) on a live tunnel; SLU_PROFILE_DRYRUN=1
 runs the same path on CPU (host planes only) for plumbing tests.
@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACE_DIR = os.path.join(REPO, ".tpu_trace")
 OUT = os.environ.get("SLU_PROFILE_OUT",
-                     os.path.join(REPO, "TPU_PROFILE_r04.json"))
+                     os.path.join(REPO, "TPU_PROFILE_r05.json"))
 
 
 def capture():
