@@ -306,11 +306,72 @@ def _zone_assignment(fp, ndev: int) -> np.ndarray:
 
 
 def _level_merge_on() -> bool:
-    """SLU_LEVEL_MERGE=1: one padded group per etree level (see the
-    merge block in build_schedule).  Off by default — on CPU the
-    padded flops are real cost; the accelerator A/B decides."""
+    """SLU_LEVEL_MERGE=1: coalesce each etree level's bucket groups
+    (cost-bounded; see the merge block in build_schedule).  Off by
+    default — on CPU the padded flops are real cost; the accelerator
+    A/B decides."""
     import os
     return os.environ.get("SLU_LEVEL_MERGE", "0") == "1"
+
+
+def _level_merge_limit() -> float:
+    """Padded/original cell-ratio bound for level merging
+    (SLU_LEVEL_MERGE_LIMIT, default 1.5)."""
+    import os
+    try:
+        v = float(os.environ.get("SLU_LEVEL_MERGE_LIMIT", "1.5"))
+    except ValueError:
+        v = 1.5
+    return max(1.0, v)
+
+
+def _coalesce_buckets(by_bucket: dict, limit: float) -> dict:
+    """Cost-bounded coalescing of one level's {(wb, mb): [sup...]}
+    bucket groups into fewer padded groups.
+
+    A merged frame must hold every member's TRUE panel and struct
+    extents: wb = max panel bucket and rb = max struct capacity
+    (mb − wb) over the members.  Merging is COST-BOUNDED (`limit`×
+    padded cells; SLU_LEVEL_MERGE_LIMIT, default 1.5): an unbounded
+    per-level merge measured 2.9× the update-slab elements at
+    n=262k — past HBM — while near-size buckets merge almost free.
+    Greedy ascending scan: buckets join the open super-bucket while
+    the accumulated padded/original cell ratio holds.  Distinct
+    greedy groups can close with the SAME padded frame (a later
+    small-panel/large-struct group can pad to an earlier group's
+    exact extents) — they fold into one group (same shape, so the
+    union is well-formed); overwriting instead would silently drop
+    fronts from the schedule."""
+    def cells(nf, wb_, rb_):
+        mb_ = wb_ + rb_
+        return nf * (2 * wb_ * mb_ + rb_ * rb_)
+
+    items = sorted(
+        ((wb0, mb0 - wb0, len(sl), sl)
+         for (wb0, mb0), sl in by_bucket.items()),
+        key=lambda t: (t[0], t[1]))
+    merged: dict = {}
+
+    def close(cur):
+        merged.setdefault((cur[0], cur[0] + cur[1]),
+                          []).extend(cur[3])
+
+    cur = None      # [wb_m, rb_m, orig_cells, slist]
+    for wb0, rb0, nf, sl in items:
+        if cur is not None:
+            wb_m = max(cur[0], wb0)
+            rb_m = max(cur[1], rb0)
+            newc = cells(len(cur[3]) + nf, wb_m, rb_m)
+            if newc <= limit * (cur[2] + cells(nf, wb0, rb0)):
+                cur[0], cur[1] = wb_m, rb_m
+                cur[2] += cells(nf, wb0, rb0)
+                cur[3] = cur[3] + sl
+                continue
+            close(cur)
+        cur = [wb0, rb0, cells(nf, wb0, rb0), list(sl)]
+    if cur is not None:
+        close(cur)
+    return merged
 
 
 def _coop_mb_min() -> int:
@@ -453,20 +514,14 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             by_bucket.setdefault((int(fp.wb[s]), int(fp.mb[s])),
                                  []).append(int(s))
         if _level_merge_on() and len(by_bucket) > 1:
-            # SLU_LEVEL_MERGE=1: collapse the level's bucket groups
-            # into ONE padded group — the latency-regime trade (fewer
-            # sequential group bodies on the device at the price of
-            # padded flops/slab; the tau/cap amalgamation's sibling
-            # lever, priced by the tools/tpu_fire.sh chain arms).
-            # The merged frame must hold every front's TRUE panel and
-            # struct extents: wb = max panel bucket, and rb = max
-            # STRUCT capacity (mb − wb per original bucket) — taking
-            # plain max(mb) could leave rb smaller than a wide-struct
-            # front needs.
-            wb_m = max(k[0] for k in by_bucket)
-            mb_m = wb_m + max(k[1] - k[0] for k in by_bucket)
-            by_bucket = {(wb_m, mb_m): [
-                s for k in sorted(by_bucket) for s in by_bucket[k]]}
+            # SLU_LEVEL_MERGE=1: coalesce the level's bucket groups
+            # into fewer padded groups (_coalesce_buckets) — the
+            # latency-regime trade: fewer sequential group bodies on
+            # the device at the price of padded flops/slab; the
+            # tau/cap amalgamation's sibling lever, priced by
+            # tools/tpu_fire.sh chain arms.
+            by_bucket = _coalesce_buckets(by_bucket,
+                                          _level_merge_limit())
         for (wb, mb), slist in sorted(by_bucket.items()):
             N = len(slist)
             rb = mb - wb
@@ -865,7 +920,8 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     # hitting a stale entry
     key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block(),
                   _coop_solve_rotate())
-           if ndev > 1 else 0, _level_merge_on())
+           if ndev > 1 else 0,
+           _level_merge_limit() if _level_merge_on() else None)
     if key not in cache:
         cache[key] = build_schedule(plan, ndev)
     return cache[key]

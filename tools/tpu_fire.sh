@@ -161,6 +161,7 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #    their desc; these env knobs don't reach the desc string).
   for arm in "SLU_DIAG_UNROLL=16" "SLU_DIAG_UNROLL=32" \
              "SLU_LEVEL_MERGE=1" \
+             "SLU_LEVEL_MERGE=1 SLU_LEVEL_MERGE_LIMIT=4" \
              "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32"; do
     ab_tmp=$(mktemp)
     env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
