@@ -103,11 +103,7 @@ def main(argv=None) -> int:
     # accelerator trade is measured WORSE there.
     from ..utils.platform import (apply_accel_amalg_defaults,
                                   complex_needs_cpu)
-    # pair_capable mirrors the run mode: the fused solver has no pair
-    # storage, so under --fused a complex system reroutes to CPU even
-    # with SLU_COMPLEX_PAIR=1 and must not get the accelerator trade
-    if args.backend != "host" and not complex_needs_cpu(
-            np.dtype(fdt), pair_capable=not args.fused):
+    if args.backend != "host" and not complex_needs_cpu(np.dtype(fdt)):
         import jax
         try:
             accel = jax.default_backend() != "cpu"
@@ -191,14 +187,19 @@ def _solve_fused(a, b, opts, stats):
         # two differently-precisioned factorizations
         from ..utils.platform import complex_device_gate
         fdt = effective_factor_dtype(a.dtype, dtype_name)
-        # pair_capable=False: the fused program builds native-complex
-        # storage — SLU_COMPLEX_PAIR must not lift its CPU gate
-        with complex_device_gate(fdt, a.dtype, pair_capable=False):
+        # the fused solver is pair-capable (make_fused_solver pair
+        # mode), so the default gate applies: SLU_COMPLEX_PAIR=1
+        # lifts it and the complex pipeline compiles complex-free
+        with complex_device_gate(fdt, a.dtype):
             step = make_fused_solver(plan, dtype=fdt)
             with stats.timer(phase):
-                x, berr, steps, tiny, _ = step(jnp.asarray(a.data),
-                                               jnp.asarray(b))
-                x.block_until_ready()
+                # host arrays in: the pair-mode wrapper must encode
+                # BEFORE anything touches the device (a complex
+                # device buffer would defeat the gate), and the
+                # non-pair jitted step transfers its operands itself
+                x, berr, steps, tiny, _ = step(a.data, b)
+                if hasattr(x, "block_until_ready"):
+                    x.block_until_ready()   # pair mode returns numpy
         stats.add_ops(phase, plan.factor_flops)
         stats.berr = float(berr)
         stats.refine_steps += int(steps)

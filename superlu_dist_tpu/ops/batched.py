@@ -1870,6 +1870,13 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         axis, ndev = None, 1
     sched = get_schedule(plan, ndev)
     dtype = np.dtype(dtype)
+    # pair mode (complex on stacked real/imag planes, ops/pair_lu):
+    # the whole fused pipeline — scale, assemble, factor, sweeps,
+    # SpMV residual, berr, while_loop — compiles complex-free; the
+    # public step wrapper encodes/decodes on the host.  Single-device
+    # only (mesh complex stays on the replicated native formulation
+    # behind its own gate).
+    pair = mesh is None and _pair_mode(dtype)
     if refine_dtype is None:
         # honor the plan's refinement contract (models/refine.py):
         # SLU_SINGLE accumulates in the working precision, otherwise in
@@ -1917,18 +1924,26 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     # ---- shared numerics pieces: ONE definition serves the fused
     # trace and the staged host loop, so the two cannot diverge ----
 
+    rrdt = _real_dtype(rdt)
+
     def _scale_impl(vals):
+        # real scale factors: plane-wise in pair mode ((2, nnz)
+        # broadcasts against (nnz,)), so one definition serves both
         return vals * ops["scale_fac"]
 
     def _pre_impl(r):
         """original-order residual -> factor-order sweep RHS (factor
-        precision, like the reference's psgsrfs)."""
+        precision, like the reference's psgsrfs).  Pair mode: r is
+        real-view encoded (n, 2R) and the real row scales apply to
+        both halves identically, so the same gather/scale works —
+        only the target dtype changes to the factor PLANE dtype."""
         return ((r * ops["row_scale"][:, None])
-                [ops["inv_final_row"]]).astype(dtype)
+                [ops["inv_final_row"]]).astype(
+                    _real_dtype(dtype) if pair else dtype)
 
     def _post_impl(y):
         """factor-order sweep output -> original-order correction."""
-        return (y[ops["final_col"]].astype(rdt)
+        return (y[ops["final_col"]].astype(rrdt if pair else rdt)
                 * ops["col_scale"][:, None])
 
     def _combine_resid(b, ax, den_a):
@@ -1940,10 +1955,39 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         return r, jnp.max(jnp.abs(r) / denom)
 
     def _resid_berr_impl(vals_r, abs_vals, b, xv):
+        if pair:
+            # pair SpMV: A and x in plane form — the product is four
+            # real SpMVs (pdgsmv's z twin through representation
+            # change); berr uses true complex moduli
+            h = xv.shape[1] // 2
+            xr, xi = xv[:, :h], xv[:, h:]
+
+            def sp(v, x):
+                return coo_spmv(ops["coo_rows"], ops["coo_cols"],
+                                v, x, n)
+
+            ax = jnp.concatenate(
+                [sp(vals_r[0], xr) - sp(vals_r[1], xi),
+                 sp(vals_r[0], xi) + sp(vals_r[1], xr)], axis=1)
+            den = sp(abs_vals, jnp.sqrt(xr * xr + xi * xi))
+            r = b - ax
+            rmod = jnp.sqrt(r[:, :h] ** 2 + r[:, h:] ** 2)
+            bmod = jnp.sqrt(b[:, :h] ** 2 + b[:, h:] ** 2)
+            denom = den + bmod
+            denom = jnp.where(denom == 0, 1, denom)
+            return r, jnp.max(rmod / denom)
         ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r, xv, n)
         den = coo_spmv(ops["coo_rows"], ops["coo_cols"],
                        abs_vals, jnp.abs(xv), n)
         return _combine_resid(b, ax, den)
+
+    def _abs_impl(vals_r):
+        """|A| for the berr denominator: complex modulus in pair
+        mode (plane-wise abs would understate it)."""
+        if pair:
+            return jnp.sqrt(vals_r[0] * vals_r[0]
+                            + vals_r[1] * vals_r[1])
+        return jnp.abs(vals_r)
 
     def _factor(scaled_vals, per_group):
         # the group-loop drivers are factor_dist's — ONE implementation
@@ -1951,7 +1995,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         # step, so the paths cannot diverge
         from ..parallel.factor_dist import _factor_loop
         out = _factor_loop(sched, scaled_vals, thresh_np, dtype,
-                           per_group, axis)
+                           per_group, axis, pair=pair)
         return list(out[:4]), out[4], out[5]
 
     def _solve_once(flats, r, per_group):
@@ -1959,8 +2003,33 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         from ..parallel.factor_dist import _solve_loop
         solve_idx = [(t[5], t[6]) for t in per_group]
         y = _solve_loop(sched, tuple(flats), _pre_impl(r), dtype,
-                        solve_idx, axis, trans=False)
+                        solve_idx, axis, trans=False, pair=pair)
         return _post_impl(y)
+
+    def _wrap_pair(step_fn):
+        """Public contract adapter for pair mode: callers pass
+        complex vals/b and receive complex x; the encode/decode is
+        host-side numpy so the compiled program never sees a complex
+        buffer (on the gated platform even a transfer-only complex
+        device array is off-limits)."""
+        if not pair:
+            return step_fn
+
+        def step(vals, b):
+            v = np.asarray(vals)
+            vp = np.stack([v.real, v.imag]).astype(
+                _real_dtype(np.promote_types(v.dtype, dtype)))
+            bb = np.asarray(b).astype(rdt)
+            benc = np.concatenate([bb.real, bb.imag], axis=1)
+            x, berr, steps, tiny, nzero = step_fn(
+                jnp.asarray(vp), jnp.asarray(benc))
+            x = np.asarray(x)
+            h = bb.shape[1]
+            xc = (x[:, :h] + 1j * x[:, h:]).astype(rdt)
+            return xc, berr, steps, tiny, nzero
+
+        step._core = step_fn      # encoded-operand core (tests lower
+        return step               # it to pin the complex-free HLO)
 
     def step_body(scaled, resid_berr, b, per_group):
         """Shared numeric pipeline: factor the scaled values, then the
@@ -2008,7 +2077,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                 steps + 1 >= max_steps + 1)
             return x, r, berr, steps + 1, stop
 
-        x0 = jnp.zeros((n, b.shape[1]), rdt)
+        x0 = jnp.zeros((n, b.shape[1]), rrdt if pair else rdt)
         inf = jnp.asarray(np.inf, _real_dtype(rdt))
         x, _, berr, steps, _ = jax.lax.while_loop(
             cond, body,
@@ -2037,14 +2106,14 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         def step(vals, b):
             vals = jnp.asarray(vals)
             panels, tiny, nzero = _staged_factor_run(
-                sched, _scale(vals), thresh_np, dtype)
-            vals_r = vals.astype(rdt)
-            abs_vals = jnp.abs(vals_r)
-            b = jnp.asarray(b).astype(rdt)
+                sched, _scale(vals), thresh_np, dtype, pair=pair)
+            vals_r = vals.astype(rrdt if pair else rdt)
+            abs_vals = _abs_impl(vals_r)
+            b = jnp.asarray(b).astype(rrdt if pair else rdt)
 
             def solve_once(r):
                 y = _staged_sweeps(sched, panels, _pre(r), dtype,
-                                   trans=False)
+                                   trans=False, pair=pair)
                 return _post(y)
 
             t32 = jnp.asarray(tiny, jnp.int32)
@@ -2055,7 +2124,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                 return x, berr, jnp.zeros((), jnp.int32), t32, z32
 
             # host mirror of the fused while_loop (same decisions)
-            x = jnp.zeros((n, b.shape[1]), rdt)
+            x = jnp.zeros((n, b.shape[1]), rrdt if pair else rdt)
             r, berr = b, np.inf
             steps, stop = 0, False
             while not stop and berr > eps:
@@ -2075,16 +2144,16 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                     jnp.asarray(max(steps - 1, 0), jnp.int32),
                     t32, z32)
 
-        return step
+        return _wrap_pair(step)
 
     if mesh is None:
         per_group_const = [g.dev(squeeze=True) for g in sched.groups]
 
         @jax.jit
         def step(vals, b):
-            b_r = b.astype(rdt)
-            vals_r = vals.astype(rdt)
-            abs_vals = jnp.abs(vals_r)
+            b_r = b.astype(rrdt if pair else rdt)
+            vals_r = vals.astype(rrdt if pair else rdt)
+            abs_vals = _abs_impl(vals_r)
 
             def resid_berr(xv):
                 return _resid_berr_impl(vals_r, abs_vals, b_r, xv)
@@ -2092,7 +2161,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return step_body(_scale_impl(vals), resid_berr, b_r,
                              per_group_const)
 
-        return step
+        return _wrap_pair(step)
 
     # mesh execution: group index arrays enter as sharded operands,
     # and so does the NUMERIC INPUT (NRformat_loc, supermatrix.h:

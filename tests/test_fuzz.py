@@ -47,7 +47,18 @@ CASES = list(range(int(_os.environ.get("SLU_FUZZ_CASES", "24"))))
 
 
 @pytest.mark.parametrize("case", CASES)
-def test_fuzz_consistency(case):
+def test_fuzz_consistency(case, monkeypatch):
+    # rotate the schedule/storage execution modes through the sweep:
+    # level-merged schedules (SLU_LEVEL_MERGE, case % 7) and, for the
+    # complex cases, the real-pair factor storage (SLU_COMPLEX_PAIR,
+    # ops/pair_lu) — the same option matrix must hold under every
+    # execution mode
+    if case % 7 == 2:
+        monkeypatch.setenv("SLU_LEVEL_MERGE", "1")
+    if case % 12 == 5:
+        # half the complex cases (6k+5): 5, 17, 29… run pair storage,
+        # 11, 23, 35… keep native complex — both modes stay covered
+        monkeypatch.setenv("SLU_COMPLEX_PAIR", "1")
     rng = np.random.default_rng(1000 + case)
     n = int(rng.integers(15, 120))
     density = float(rng.uniform(0.02, 0.15))

@@ -199,6 +199,41 @@ def test_pair_gate_interaction(monkeypatch):
     assert plat.complex_needs_cpu(np.float64) is False
 
 
+def test_fused_solver_pair(problem):
+    """The whole fused pipeline (scale + assemble + factor + sweeps +
+    SpMV residual + berr + while_loop refinement) in pair mode: c128
+    to full accuracy, c64 factor + c128 refinement to the
+    mixed-precision contract, and the jitted core complex-free."""
+    import jax.numpy as jnp
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    a, xtrue, b = problem
+    plan = plan_factorization(a, Options(factor_dtype="complex128",
+                                         refine_dtype="complex128"))
+    step = make_fused_solver(plan, dtype="complex128")
+    x, berr, steps, tiny, nzero = step(a.data, b[:, None])
+    assert np.asarray(x).dtype == np.complex128
+    np.testing.assert_allclose(np.asarray(x)[:, 0], xtrue, rtol=1e-8)
+    assert float(berr) < 1e-14
+    # encoded-operand core compiles with NO complex HLO at all
+    nnz = len(plan.coo_rows)
+    txt = step._core.lower(
+        jnp.zeros((2, nnz), jnp.float64),
+        jnp.zeros((plan.n, 2), jnp.float64)).as_text()
+    assert "c128" not in txt and "c64" not in txt
+    # mixed precision: c64 planes on the factor, c128 accumulator
+    plan2 = plan_factorization(a, Options(factor_dtype="complex64",
+                                          refine_dtype="complex128"))
+    step2 = make_fused_solver(plan2, dtype="complex64")
+    x2, _, st2, _, _ = step2(a.data, b[:, None])
+    np.testing.assert_allclose(np.asarray(x2)[:, 0], xtrue, rtol=1e-8)
+    assert int(st2) >= 1
+    # staged variant, same contract
+    step3 = make_fused_solver(plan, dtype="complex128", staged=True)
+    x3, _, _, _, _ = step3(a.data, b[:, None])
+    np.testing.assert_allclose(np.asarray(x3)[:, 0], xtrue, rtol=1e-8)
+
+
 def test_pair_handle_survives_env_change(problem, monkeypatch):
     """A factorization handle outlives the env var that selected its
     storage: solve derives pair-ness from the flats themselves

@@ -138,8 +138,20 @@ def run_check(name):
         gated = bool(complex_needs_cpu(np.complex128))
         x, lu, st = gssvx(Options(), az, az.to_scipy() @ xtrue)
         relerr = float(np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue))
-        from superlu_dist_tpu.ops.batched import _lu_is_pair
+        from superlu_dist_tpu.ops.batched import (_lu_is_pair,
+                                                  make_fused_solver)
+        # the fused one-program pipeline in pair mode too (pddrive
+        # --fused complex on-chip)
+        from superlu_dist_tpu.plan.plan import plan_factorization
+        plan = plan_factorization(az, Options(
+            factor_dtype="complex128", refine_dtype="complex128"))
+        stepf = make_fused_solver(plan, dtype="complex128",
+                                  staged=False)
+        xf, fberr, *_ = stepf(az.data, (az.to_scipy() @ xtrue)[:, None])
+        frelerr = float(np.linalg.norm(np.asarray(xf)[:, 0] - xtrue)
+                        / np.linalg.norm(xtrue))
         return dict(relerr=relerr, berr=st.berr, gated_to_cpu=gated,
+                    fused_relerr=frelerr, fused_berr=float(fberr),
                     pair_storage=bool(lu.device_lu is not None
                                       and _lu_is_pair(lu.device_lu)))
 
