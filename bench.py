@@ -116,11 +116,26 @@ def _hw_record_path() -> str:
 
 
 def _config_key(desc: str) -> str:
-    """Normalized config identity: the tau/cap annotation describes a
-    tuning arm, not the problem — strip it so a record captured at the
-    accelerator amalgamation defaults matches the same problem run
-    without them (a CPU capture moment never applies those defaults)."""
+    """Scipy-baseline cache key: the tau/cap and staged annotations
+    describe OUR solver arm, not the problem being solved — every arm
+    shares one primed baseline entry."""
+    return re.sub(r" tau=[^ ]+| staged", "", desc)
+
+
+def _hw_key(desc: str) -> str:
+    """Hardware-record (promotion) identity: strips the tau/cap
+    tuning-arm annotation only.  ' staged' stays — a staged wall
+    includes the per-group dispatch tax, so a staged measurement must
+    never be promoted as the fused configuration's number (or vice
+    versa)."""
     return re.sub(r" tau=[^ ]+", "", desc)
+
+
+def _staged_env_on() -> bool:
+    """Mirror ops/batched.staged_enabled's truthy set — a run forced
+    staged via any accepted spelling must be DISCLOSED as staged."""
+    return os.environ.get("SLU_STAGED", "").strip().lower() \
+        in ("1", "true", "on")
 
 
 def _load_hw_record(expect_desc: str):
@@ -138,7 +153,7 @@ def _load_hw_record(expect_desc: str):
             rec = json.load(f)
         if rec.get("cpu_fallback") or rec.get("promoted"):
             return None
-        if rec.get("desc") != _config_key(expect_desc):
+        if rec.get("desc") != _hw_key(expect_desc):
             return None
         if not isinstance(rec.get("value"), (int, float)) \
                 or rec["value"] <= 0:
@@ -530,6 +545,10 @@ def main():
         # records are distinguishable in the sweep telemetry
         desc += (f" tau={os.environ['SUPERLU_AMALG_TAU_PCT']}%"
                  f"/cap={os.environ.get('SUPERLU_AMALG_CAP', 'dflt')}")
+    if _staged_env_on():
+        # staged per-group dispatch (the 262k-class sweep mode):
+        # disclose it — the wall includes the per-group dispatch tax
+        desc += " staged"
 
     try:
         r = _run_config(a, desc, nrhs, jnp)
@@ -595,7 +614,7 @@ def main():
         # saved-flag rides along so tpu_fire.sh can install the
         # stdout line instead when the in-process save failed
         line.update(ts=time.strftime("%Y-%m-%dT%H:%M:%S"),
-                    desc=_config_key(r["desc"]), commit=_git_head())
+                    desc=_hw_key(r["desc"]), commit=_git_head())
         line["hw_record_saved"] = _save_hw_record(line)
     hw = (_load_hw_record(r["desc"])
           if primary_mode and cpu_fallback and r["accuracy_ok"]
@@ -729,15 +748,35 @@ def main():
                 return False
 
         emit(r)
-        # (k, nrhs, shape): the scale configs are always the 3D
-        # family (SLU_BENCH_SWEEP_KS overrides the ladder); the
-        # many-RHS config reuses the primary's shape
-        extras = [(k2.strip(), "1", "3d") for k2 in os.environ.get(
-            "SLU_BENCH_SWEEP_KS", "48,64").split(",") if k2.strip()]
+        # (k, nrhs, shape, extra_env): the scale configs are always
+        # the 3D family (SLU_BENCH_SWEEP_KS overrides the ladder);
+        # the many-RHS config reuses the primary's shape.  The
+        # n=262k-class config (k ≥ 64) runs STAGED: its monolithic
+        # fused compile has never fit a window (>2400 s; the k=48
+        # compile alone took ~700 s), while staged execution compiles
+        # ~70 bounded per-group programs that land in the persistent
+        # cache INCREMENTALLY — a window that dies mid-compile still
+        # banks its finished groups for the next one.  The dispatch
+        # tax through the tunnel (~200 ms × groups) costs real
+        # seconds but a measured number beats an unfinished compile.
+        extras = []
+        for k2 in os.environ.get("SLU_BENCH_SWEEP_KS",
+                                 "48,64").split(","):
+            k2 = k2.strip()
+            if not k2:
+                continue
+            try:
+                min_k = int(os.environ.get("SLU_BENCH_STAGED_MIN_K",
+                                           "64"))
+            except ValueError:
+                min_k = 64
+            big = k2.isdigit() and int(k2) >= min_k
+            extras.append((k2, "1", "3d",
+                           {"SLU_STAGED": "1"} if big else {}))
         if nrhs != 64:  # skip if the primary already covered nrhs=64
-            extras.insert(0, (str(k), "64", shape))  # many-RHS regime
+            extras.insert(0, (str(k), "64", shape, {}))
         aborted = False
-        for k2, nr2, shp2 in extras:
+        for k2, nr2, shp2, env2 in extras:
             d2 = f"sweep config k={k2} nrhs={nr2} shape={shp2}"
             if aborted:
                 emit(dict(desc=d2, error="skipped: tunnel died "
@@ -747,11 +786,12 @@ def main():
                 n2 = int(k2) ** 3 if shp2 == "3d" else int(k2) ** 2
                 d2 = (f"{'3D' if shp2 == '3d' else '2D'} Laplacian "
                       f"n={n2}") + (f" nrhs={nr2}" if nr2 != "1"
-                                    else "")
+                                    else "") \
+                    + (" staged" if env2.get("SLU_STAGED") else "")
                 env = dict(os.environ, SLU_BENCH_K=k2,
                            SLU_BENCH_NRHS=nr2, SLU_BENCH_SHAPE=shp2,
                            SLU_BENCH_EMIT_RECORD="1",
-                           SLU_BENCH_ASSUME_LIVE="1")
+                           SLU_BENCH_ASSUME_LIVE="1", **env2)
                 env.pop("SLU_BENCH_SWEEP", None)
                 rec, rc, err, timed_out = run_config_child(env, budget)
                 if rec:

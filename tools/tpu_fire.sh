@@ -79,13 +79,12 @@ SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
   timeout 2100 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
 stamp "smoke rc=$? -> $smoke_out"
 
-# 3+4 run on hardware only: the sweep's n=262k config uses the fused
-# one-program formulation, whose XLA:CPU compile alone runs hours —
-# the CPU rehearsal's budget claim is steps 1-2, which are the
-# whole <5-minute window plan.
+# 3+4 run on hardware only: the sweep's scale configs compile for
+# many minutes even staged — the CPU rehearsal's budget claim is
+# steps 1-2, which are the whole <5-minute window plan.
 if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   # 2.5 One profiled step of the warm fused solver -> committed
-  #     op-level summary (TPU_PROFILE_r04.json; raw trace stays in
+  #     op-level summary (TPU_PROFILE_r05.json; raw trace stays in
   #     gitignored .tpu_trace/).  Early in the sequence: ~2 min warm,
   #     and the per-op device-time breakdown is the round-5
   #     optimization starting point for the latency-bound regime.
@@ -107,9 +106,10 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
   #    baselines are primed outside windows (SCIPY_BASELINE.json), so
-  #    the whole budget is device time — the 08:27 window's n=262k
-  #    config spent most of its 1500 s on the in-window scipy solve
-  #    and died mid-TPU-compile.
+  #    the whole budget is device time.  The n=262k-class config
+  #    runs STAGED (bench.py sweep: bounded per-group compiles that
+  #    land in the persistent cache incrementally) — its monolithic
+  #    fused compile never fit a window.
   # outer 9000 > primary + 3 children x 2400: every config must get
   # its full budget AND its per-config error record on timeout — an
   # outer SIGKILL mid-child would lose the record silently
