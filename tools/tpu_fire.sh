@@ -16,7 +16,7 @@
 # Artifacts (repo root): TPU_BENCH_LIVE.json (the on-TPU bench line),
 # TPU_SMOKE.jsonl (hardware smoke incl. the complex-path codec-gating
 # measurement), BENCH_SWEEP.jsonl (secondary configs),
-# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 5), FIRE_*.log.
+# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 8), FIRE_*.log.
 set -u
 repo=$(cd "$(dirname "$0")/.." && pwd)
 if [ "${SLU_FIRE_DRYRUN:-0}" = "1" ]; then
@@ -68,7 +68,19 @@ else
 fi
 rm -f "$bench_tmp"
 
-# 2. Hardware smoke — the complex-path cleanliness measurement that
+# 2. One profiled step of the warm fused solver -> committed op-level
+#    summary (TPU_PROFILE_r05.json; raw trace stays in gitignored
+#    .tpu_trace/).  SECOND in the sequence, before the smoke: ~2 min
+#    warm, and the per-op device-time breakdown is the round's single
+#    most valuable missing artifact (VERDICT r4 weak #3) — a short
+#    window must capture it even if nothing after runs.  Hardware
+#    only (the dryrun's CPU trace answers nothing).
+if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
+  timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
+  stamp "profile rc=$?"
+fi
+
+# 3. Hardware smoke — the complex-path cleanliness measurement that
 #    decides the real-view codec gate (TPU_SMOKE.jsonl), the pair
 #    lowering certification (c128_pair_*), Pallas compile.  240 s per
 #    check: generous for the measured ~92 s compile class, and a
@@ -79,30 +91,25 @@ SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
   timeout 2100 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
 stamp "smoke rc=$? -> $smoke_out"
 
-# 3+4 run on hardware only: the sweep's scale configs compile for
-# many minutes even staged — the CPU rehearsal's budget claim is
-# steps 1-2, which are the whole <5-minute window plan.
+# Everything below step 3 runs on hardware only: the sweep's scale
+# configs compile for many minutes even staged.  The CPU rehearsal's
+# budget claim is steps 1 and 3 (bench + smoke; step 2's profile is
+# hardware-only), which are the short-window plan.
 if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
-  # 2.5 One profiled step of the warm fused solver -> committed
-  #     op-level summary (TPU_PROFILE_r05.json; raw trace stays in
-  #     gitignored .tpu_trace/).  Early in the sequence: ~2 min warm,
-  #     and the per-op device-time breakdown is the round-5
-  #     optimization starting point for the latency-bound regime.
-  timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
-  stamp "profile rc=$?"
-  # and the n=110,592 step (warm executable from the sweep cache):
+  # 4. The n=110,592 profiled step (warm executable from the sweep
+  #    cache):
   # the scale regime's op mix differs from n=27k and is where the
   # round-5 wall/flop question actually lives
   SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r05_k48.json" \
     timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
   stamp "profile k48 rc=$?"
-  # 2.7 Solve-only latency vs nrhs (1/8/64) on held factors — the
+  # 5. Solve-only latency vs nrhs (1/8/64) on held factors — the
   #     config-#5 / pdtest -s 64 regime (VERDICT r4 item 7); the
   #     factor executable is warm from step 1's cache
   timeout 1200 python "$repo/tools/solve_latency.py" \
     >> "$repo/SOLVE_LATENCY.jsonl" 2>> "$log"
   stamp "solve_latency rc=$?"
-  # 3. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
+  # 6. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
   #    baselines are primed outside windows (SCIPY_BASELINE.json), so
@@ -117,10 +124,10 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   SLU_SWEEP_CONFIG_TIMEOUT=${SLU_SWEEP_CONFIG_TIMEOUT:-2400} \
     timeout 9000 python "$repo/bench.py" >> "$log" 2>&1
   stamp "sweep rc=$?"
-  # 4. Pallas on-chip A/B (kernel-level; cheapest to lose).
+  # 7. Pallas on-chip A/B (kernel-level; cheapest to lose).
   timeout 1800 python "$repo/tools/pallas_ab.py" >> "$log" 2>&1
   stamp "pallas_ab rc=$?"
-  # 5. Amalgamation A/B on the primary config (long windows only —
+  # 8. Amalgamation A/B on the primary config (long windows only —
   #    each variant recompiles).  The TPU run is latency-bound (MFU
   #    0.01% measured 2026-08-01): merging supernodes trades cheap
   #    MXU flops for fewer sequential level steps, and only hardware
@@ -148,7 +155,7 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     fi
     rm -f "$ab_tmp"
   done
-  # 6. Sequential-chain arms (the latency-bound hypothesis, round-5
+  # 9. Sequential-chain arms (the latency-bound hypothesis, round-5
   #    MFU attack).  SLU_DIAG_UNROLL fuses more rank-1 pivot steps
   #    per XLA body (chain length wb/unroll per diag block);
   #    SLU_LEVEL_MERGE collapses each etree level's bucket groups
