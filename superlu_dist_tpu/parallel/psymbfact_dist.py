@@ -122,6 +122,91 @@ class JaxProcessComm:
         return [pickle.loads(p)[self.rank] for p in parts]
 
 
+class ThreadComm:
+    """P barrier-synchronized virtual processes in ONE process — the
+    certification transport (tests, __graft_entry__ dryrun).  One
+    instance per rank, sharing slots/barrier state: the collectives
+    have real allgather/bcast/alltoall semantics (every rank
+    deposits, barrier, every rank reads), so ordering bugs and
+    one-sided raises deadlock or fail loudly instead of passing
+    vacuously.  `spy` records every payload that crossed a
+    collective, for no-values/wire-accounting assertions."""
+
+    def __init__(self, nproc, rank, shared):
+        self.nproc = nproc
+        self.rank = rank
+        self._s = shared
+
+    @staticmethod
+    def make_group(nproc, timeout=60):
+        # timeout: deadlock breaker only.  Raise it for scale tests —
+        # P CPU-bound ranks timeshare the host, so the first barrier
+        # arrival legitimately waits ~(P-1)x one rank's phase time.
+        import threading
+        shared = {
+            "slots": [None] * nproc,
+            "barrier": threading.Barrier(nproc, timeout=timeout),
+            "spy": [],
+            "lock": threading.Lock(),
+        }
+        return [ThreadComm(nproc, r, shared) for r in range(nproc)]
+
+    def _exchange(self, payload):
+        s = self._s
+        s["slots"][self.rank] = payload
+        with s["lock"]:
+            s["spy"].append((self.rank, payload))
+        s["barrier"].wait()
+        out = list(s["slots"])
+        s["barrier"].wait()  # all read before any rank reuses slots
+        return out
+
+    def allgather(self, payload):
+        return self._exchange(payload)
+
+    def gather0(self, payload):
+        out = self._exchange(payload)
+        return out if self.rank == 0 else None
+
+    def bcast(self, payload):
+        out = self._exchange(payload if self.rank == 0 else b"")
+        return out[0]
+
+    def alltoall(self, payloads):
+        # true pairwise exchange: rank r receives payloads[r] from
+        # every rank (the spy records the full per-rank send list, so
+        # wire-accounting tests can sum the real sent bytes)
+        out = self._exchange(list(payloads))
+        return [out[r][self.rank] for r in range(self.nproc)]
+
+
+def run_spmd(comms, fn):
+    """Run fn(rank_comm, rank) on every rank of a ThreadComm group;
+    returns (results, errors) per rank.  No barrier.abort() on
+    failure: aborting races with ranks still draining the same
+    barrier generation (CPython Barrier semantics) and corrupts THEIR
+    error into BrokenBarrierError; a genuinely one-sided death is
+    broken by the barrier's configured timeout instead (make_group's
+    `timeout`)."""
+    import threading
+    results = [None] * len(comms)
+    errors = [None] * len(comms)
+
+    def work(r):
+        try:
+            results[r] = fn(comms[r], r)
+        except Exception as e:  # noqa: BLE001 — surfaced to caller
+            errors[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,))
+               for r in range(len(comms))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
 def default_comm():
     import jax
     return JaxProcessComm() if jax.process_count() > 1 else LocalComm()

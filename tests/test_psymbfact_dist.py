@@ -36,59 +36,10 @@ from superlu_dist_tpu.utils.testmat import laplacian_3d, random_unsymmetric
 from test_multihost_plan import _assert_plans_equal
 
 
-class ThreadComm:
-    """P barrier-synchronized virtual processes.  One instance per
-    rank, sharing slots/barrier state — the collectives have real
-    allgather/bcast semantics (every rank deposits, barrier, every
-    rank reads), so ordering bugs and one-sided raises deadlock or
-    fail loudly instead of passing vacuously.  `spy` records every
-    payload that crossed a collective, for the no-values assertions."""
-
-    def __init__(self, nproc, rank, shared):
-        self.nproc = nproc
-        self.rank = rank
-        self._s = shared
-
-    @staticmethod
-    def make_group(nproc, timeout=60):
-        # timeout: deadlock breaker only.  Raise it for scale tests —
-        # P CPU-bound ranks timeshare the host, so the first barrier
-        # arrival legitimately waits ~(P-1)x one rank's phase time.
-        shared = {
-            "slots": [None] * nproc,
-            "barrier": threading.Barrier(nproc, timeout=timeout),
-            "spy": [],
-            "lock": threading.Lock(),
-        }
-        return [ThreadComm(nproc, r, shared) for r in range(nproc)]
-
-    def _exchange(self, payload):
-        s = self._s
-        s["slots"][self.rank] = payload
-        with s["lock"]:
-            s["spy"].append((self.rank, payload))
-        s["barrier"].wait()
-        out = list(s["slots"])
-        s["barrier"].wait()  # all read before any rank reuses slots
-        return out
-
-    def allgather(self, payload):
-        return self._exchange(payload)
-
-    def gather0(self, payload):
-        out = self._exchange(payload)
-        return out if self.rank == 0 else None
-
-    def bcast(self, payload):
-        out = self._exchange(payload if self.rank == 0 else b"")
-        return out[0]
-
-    def alltoall(self, payloads):
-        # true pairwise exchange: rank r receives payloads[r] from
-        # every rank (the spy records the full per-rank send list, so
-        # wire-accounting tests can sum the real sent bytes)
-        out = self._exchange(list(payloads))
-        return [out[r][self.rank] for r in range(self.nproc)]
+# the thread-backed virtual SPMD group moved into the package
+# (certification transport for __graft_entry__'s dryrun too); tests
+# keep importing it from here
+from superlu_dist_tpu.parallel.psymbfact_dist import ThreadComm  # noqa: E402,F401
 
 
 def _slices_from_cuts(a: CSRMatrix, cuts):
@@ -113,30 +64,9 @@ def _row_slices(a: CSRMatrix, nproc: int):
     return _slices_from_cuts(a, cuts)
 
 
-def _run_spmd(comms, fn):
-    """Run fn(rank_comm, rank) on every rank; collect per-rank
-    results/errors.  No barrier.abort() on failure: aborting races
-    with ranks still draining the same barrier generation (CPython
-    Barrier semantics) and corrupts THEIR error into
-    BrokenBarrierError; a genuinely one-sided death is broken by the
-    barrier's configured timeout instead (make_group's `timeout`,
-    default 60 s, raised for scale tests)."""
-    results = [None] * len(comms)
-    errors = [None] * len(comms)
-
-    def work(r):
-        try:
-            results[r] = fn(comms[r], r)
-        except Exception as e:  # noqa: BLE001 — surfaced below
-            errors[r] = e
-
-    threads = [threading.Thread(target=work, args=(r,))
-               for r in range(len(comms))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    return results, errors
+# per-rank runner moved into the package next to ThreadComm
+from superlu_dist_tpu.parallel.psymbfact_dist import (  # noqa: E402
+    run_spmd as _run_spmd)
 
 
 _MATS = [

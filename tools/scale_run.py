@@ -11,7 +11,7 @@ n=943k) scaled to what one host executes in reasonable wall-clock;
 the reference's equivalent certification is its Summit batch scripts
 (example_scripts/batch_script_mpi_runit_summit_4k.sh).
 
-Writes ONE json file (SLU_SCALE_OUT, default SCALE_r04.json at the
+Writes ONE json file (SLU_SCALE_OUT, default SCALE_r05.json at the
 repo root) with phase wall-clocks, FACT GFLOP/s, berr/residual/relerr,
 refinement steps, peak RSS, slab accounting, and the staged program
 census.  Run:
@@ -37,7 +37,7 @@ os.environ.setdefault("SLU_STAGED", "1")   # the audikw_1-scale path
 def main():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out_path = os.environ.get(
-        "SLU_SCALE_OUT", os.path.join(repo, "SCALE_r04.json"))
+        "SLU_SCALE_OUT", os.path.join(repo, "SCALE_r05.json"))
 
     from superlu_dist_tpu.utils.cache import (cache_dir_for,
                                               ensure_portable_cpu_isa)
