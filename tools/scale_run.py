@@ -39,6 +39,18 @@ def main():
     out_path = os.environ.get(
         "SLU_SCALE_OUT", os.path.join(repo, "SCALE_r05.json"))
 
+    # the staged 262k warmup JIT-compiles hundreds of programs and
+    # exhausts the default vm.max_map_count (65530): LLVM reports
+    # ENOMEM with >100 GB free and the run segfaults (measured
+    # 2026-08-02).  Raise it best-effort before jax loads.
+    try:
+        with open("/proc/sys/vm/max_map_count", "r+") as f:
+            if int(f.read().strip()) < 1048576:
+                f.seek(0)
+                f.write("1048576")
+    except OSError:
+        pass
+
     from superlu_dist_tpu.utils.cache import (cache_dir_for,
                                               ensure_portable_cpu_isa)
     os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(

@@ -19,6 +19,12 @@ export PYTHONPATH=$repo:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}
 period=${SLU_WATCH_PERIOD:-150}
 probe_timeout=${SLU_WATCH_PROBE_TIMEOUT:-90}
 stamp() { echo "[watch $(date +%H:%M:%S)] $*"; }
+# JIT-heavy runs (staged 262k warmup) exhaust the default
+# vm.max_map_count (65530) — LLVM reports ENOMEM with >100 GB free
+# and the process segfaults in unwind (measured 2026-08-02).  Assert
+# the raised limit every arm so a VM restart cannot silently
+# reintroduce the crash; best-effort (non-root fails harmlessly).
+sysctl -w vm.max_map_count=1048576 >/dev/null 2>&1 || true
 stamp "armed (period=${period}s probe_timeout=${probe_timeout}s)"
 while :; do
   if pgrep -f "tools/tpu_fire.sh" >/dev/null 2>&1 \
