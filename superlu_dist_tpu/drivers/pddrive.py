@@ -169,7 +169,6 @@ def main(argv=None) -> int:
 
 
 def _solve_fused(a, b, opts, stats):
-    import jax.numpy as jnp
     from ..ops.batched import make_fused_solver
     from ..plan.plan import plan_factorization
 
