@@ -29,6 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = r"""
 import os, sys
 rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+mode = sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -36,7 +37,7 @@ jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                            num_processes=2, process_id=rank)
 print("INIT-OK rank", rank, flush=True)
 import numpy as np
-from superlu_dist_tpu.options import Options
+from superlu_dist_tpu.options import ColPerm, Options
 from superlu_dist_tpu.parallel.multihost import serialize_plan
 from superlu_dist_tpu.parallel.psymbfact_dist import (
     default_comm, plan_factorization_dist)
@@ -45,12 +46,16 @@ comm = default_comm()
 assert type(comm).__name__ == "JaxProcessComm", type(comm)
 from superlu_dist_tpu.utils.testmat import laplacian_3d
 a = laplacian_3d(6)
+# "parmetis" runs the DISTRIBUTED ordering over the real wire —
+# the one path that exercises JaxProcessComm.alltoall
+opts = Options(col_perm=ColPerm.PARMETIS) if mode == "parmetis" \
+    else Options()
 cut = a.m // 2 + 3  # deliberately uneven
 lo, hi = (0, cut) if rank == 0 else (cut, a.m)
 ip = a.indptr[lo:hi + 1] - a.indptr[lo]
 sl = slice(int(a.indptr[lo]), int(a.indptr[hi]))
 plan = plan_factorization_dist(lo, ip, a.indices[sl], a.data[sl],
-                               a.m, options=Options(), comm=comm)
+                               a.m, options=opts, comm=comm)
 with open(out, "wb") as f:
     f.write(serialize_plan(plan))
 print("DONE rank", rank, flush=True)
@@ -65,7 +70,8 @@ def _free_port():
     return port
 
 
-def test_two_real_processes_plan_bit_identical(tmp_path):
+@pytest.mark.parametrize("mode", ["default", "parmetis"])
+def test_two_real_processes_plan_bit_identical(tmp_path, mode):
     port = str(_free_port())
     outs = [str(tmp_path / f"plan_{r}.bin") for r in (0, 1)]
     # prepend the repo to any inherited PYTHONPATH (lottery_util.py
@@ -82,7 +88,7 @@ def test_two_real_processes_plan_bit_identical(tmp_path):
     log_paths = [tmp_path / f"rank_{r}.log" for r in (0, 1)]
     log_files = [open(p, "w") for p in log_paths]
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _WORKER, str(r), port, outs[r]],
+        [sys.executable, "-c", _WORKER, str(r), port, outs[r], mode],
         env=env, stdout=log_files[r], stderr=subprocess.STDOUT,
         text=True, cwd=str(tmp_path)) for r in (0, 1)]
     timed_out = False
@@ -120,7 +126,15 @@ def test_two_real_processes_plan_bit_identical(tmp_path):
 
     from test_multihost_plan import _assert_plans_equal
 
-    ref = plan_factorization(laplacian_3d(6), Options())
     plans = [deserialize_plan(open(o, "rb").read()) for o in outs]
-    for plan in plans:
-        _assert_plans_equal(ref, plan)
+    if mode == "parmetis":
+        # the distributed ordering differs from the host's by design
+        # (the get_perm_c_parmetis relationship): the contract over
+        # the real wire is cross-rank identity + validity
+        _assert_plans_equal(plans[0], plans[1])
+        n = laplacian_3d(6).n
+        assert np.array_equal(np.sort(plans[0].perm_c), np.arange(n))
+    else:
+        ref = plan_factorization(laplacian_3d(6), Options())
+        for plan in plans:
+            _assert_plans_equal(ref, plan)
