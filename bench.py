@@ -119,7 +119,7 @@ def _config_key(desc: str) -> str:
     """Scipy-baseline cache key: the tau/cap and staged annotations
     describe OUR solver arm, not the problem being solved — every arm
     shares one primed baseline entry."""
-    return re.sub(r" tau=[^ ]+| staged", "", desc)
+    return re.sub(r" tau=[^ ]+| staged| fdt=[^ ]+", "", desc)
 
 
 def _hw_key(desc: str) -> str:
@@ -404,12 +404,22 @@ def _run_config(a, desc, nrhs, jnp):
         t_scipy, ref_relerr = _measure_scipy(a, b, xtrue)
         _scipy_cache_put(cache_desc, t_scipy, ref_relerr)
 
-    # --- ours: fused f32 factor + f64 refine, ONE XLA program ---
-    opts = Options(factor_dtype="float32")
+    # --- ours: fused low-precision factor + f64 refine, ONE XLA
+    # program.  SLU_BENCH_FACTOR_DTYPE (default float32) selects the
+    # factor precision arm: bfloat16 runs the MXU single-pass (vs the
+    # 6-pass full-f32 contract) at the cost of ~2-3x more refinement
+    # sweeps — which regime wins is a hardware question (fire-plan
+    # chain arm) ---
+    fdt = os.environ.get("SLU_BENCH_FACTOR_DTYPE", "float32")
+    # low-precision arms pay in refinement sweeps (bf16 measured ~8
+    # vs f32's ~3); headroom over the default cap so a 9th sweep
+    # shows up as steps telemetry, not a silent accuracy failure
+    opts = (Options(factor_dtype=fdt) if fdt == "float32"
+            else Options(factor_dtype=fdt, max_refine_steps=16))
     t0 = time.perf_counter()
     plan = plan_factorization(a, opts, autotune=True)
     t_plan = time.perf_counter() - t0
-    step = make_fused_solver(plan, dtype="float32")
+    step = make_fused_solver(plan, dtype=fdt)
     vals = jnp.asarray(a.data)
     bb = jnp.asarray(b[:, None] if b.ndim == 1 else b)
 
@@ -431,6 +441,7 @@ def _run_config(a, desc, nrhs, jnp):
     rec = dict(desc=desc, t_scipy=t_scipy, ref_relerr=ref_relerr,
                t_plan=t_plan, t_warm=t_warm, best=best, relerr=relerr,
                gflops=plan.factor_flops / best / 1e9,
+               refine_steps=int(steps), berr=float(berr),
                accuracy_ok=bool(relerr < 1e-9))
     if plan.true_factor_flops and \
             plan.true_factor_flops < plan.factor_flops:
@@ -549,6 +560,13 @@ def main():
         # staged per-group dispatch (the 262k-class sweep mode):
         # disclose it — the wall includes the per-group dispatch tax
         desc += " staged"
+    fdt_arm = os.environ.get("SLU_BENCH_FACTOR_DTYPE", "float32")
+    if fdt_arm != "float32":
+        # factor-precision arm (e.g. bfloat16): a different solver
+        # arm with different refinement behavior — disclosed, and
+        # kept in the hardware-record key (never promoted as the
+        # f32 configuration's number)
+        desc += f" fdt={fdt_arm}"
 
     try:
         r = _run_config(a, desc, nrhs, jnp)
@@ -586,7 +604,9 @@ def main():
                     "on the unamalgamated structure")
     line = {
         "metric": "fused sparse LU solve throughput "
-                  f"({r['desc']}, f32 factor + f64 device "
+                  f"({r['desc']}, "
+                  f"{'f32' if fdt_arm == 'float32' else fdt_arm} "
+                  "factor + f64 device "
                   f"IR; relerr {r['relerr']:.1e} vs scipy "
                   f"{r['ref_relerr']:.1e}; "
                   f"plan {r['t_plan']:.2f}s warmup {r['t_warm']:.1f}s"

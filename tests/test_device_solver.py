@@ -78,3 +78,22 @@ def test_device_factored_reuse():
     x2 = solve(lu, b2)
     np.testing.assert_allclose(a.to_scipy() @ x1, b1, atol=1e-9)
     np.testing.assert_allclose(a.to_scipy() @ x2, b2, atol=1e-9)
+
+
+def test_bfloat16_factor_with_f64_refinement():
+    """Beyond-reference precision rung: bfloat16 factorization (the
+    MXU's native single-pass format) + f64 iterative refinement
+    reaches full f64 accuracy on well-conditioned systems, with the
+    escalation gate as the backstop for everything else — the
+    psgssvx_d2 strategy extended one rung down."""
+    a = laplacian_2d(12)
+    xtrue, b = manufactured_rhs(a)
+    opts = Options(factor_dtype="bfloat16", refine_dtype="float64")
+    x, lu, st = gssvx(opts, a, b, backend="jax")
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-10, relerr
+    assert st.refine_steps >= 3      # bf16 pays in sweeps, not bits
+    # the accuracy must come FROM the bf16 rung, not from a silent
+    # escalation to an f64 refactorization
+    assert st.escalations == 0
+    assert lu.effective_options.factor_dtype == "bfloat16"

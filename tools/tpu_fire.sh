@@ -165,7 +165,9 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #    TPU_AB_CHAIN.jsonl format: each arm appends TWO lines — an
   #    {"arm": ...} header, then the bench record — unlike
   #    TPU_AB_TAU.jsonl's bare records (tau arms self-annotate in
-  #    their desc; these env knobs don't reach the desc string).
+  #    their desc; these env knobs don't reach the desc string —
+  #    except SLU_BENCH_FACTOR_DTYPE and SLU_STAGED, which bench.py
+  #    self-annotates as ' fdt=…' / ' staged').
   #    The SLU_TPU_PALLAS arms price the VMEM panel-LU kernel IN THE
   #    FULL STEP: it loses the isolated kernel A/B 0.4-0.5x
   #    (PALLAS_AB.json), but one kernel invocation replaces the
@@ -177,7 +179,8 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
              "SLU_LEVEL_MERGE=1 SLU_LEVEL_MERGE_LIMIT=4" \
              "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32" \
              "SLU_TPU_PALLAS=1" \
-             "SLU_TPU_PALLAS=1 SLU_LEVEL_MERGE=1"; do
+             "SLU_TPU_PALLAS=1 SLU_LEVEL_MERGE=1" \
+             "SLU_BENCH_FACTOR_DTYPE=bfloat16"; do
     ab_tmp=$(mktemp)
     env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
       timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
