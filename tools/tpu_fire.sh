@@ -16,7 +16,7 @@
 # Artifacts (repo root): TPU_BENCH_LIVE.json (the on-TPU bench line),
 # TPU_SMOKE.jsonl (hardware smoke incl. the complex-path codec-gating
 # measurement), BENCH_SWEEP.jsonl (secondary configs),
-# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 8), FIRE_*.log.
+# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 9), FIRE_*.log.
 set -u
 repo=$(cd "$(dirname "$0")/.." && pwd)
 if [ "${SLU_FIRE_DRYRUN:-0}" = "1" ]; then
@@ -96,19 +96,56 @@ stamp "smoke rc=$? -> $smoke_out"
 # budget claim is steps 1 and 3 (bench + smoke; step 2's profile is
 # hardware-only), which are the short-window plan.
 if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
-  # 4. The n=110,592 profiled step (warm executable from the sweep
-  #    cache):
-  # the scale regime's op mix differs from n=27k and is where the
-  # round-5 wall/flop question actually lives
-  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r05_k48.json" \
-    timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
-  stamp "profile k48 rc=$?"
-  # 5. Solve-only latency vs nrhs (1/8/64) on held factors — the
-  #     config-#5 / pdtest -s 64 regime (VERDICT r4 item 7); the
-  #     factor executable is warm from step 1's cache
+  # 4. Solve-only latency vs nrhs (1/8/64) on held factors — the
+  #    config-#5 / pdtest -s 64 regime (VERDICT r4 item 7); the
+  #    factor executable is warm from step 1's cache, so this is
+  #    minutes, not compiles
   timeout 1200 python "$repo/tools/solve_latency.py" \
     >> "$repo/SOLVE_LATENCY.jsonl" 2>> "$log"
   stamp "solve_latency rc=$?"
+  # 5. Sequential-chain arms (the latency-bound hypothesis — the
+  #    round's ONE JOB, so they run BEFORE the multi-hour sweep).
+  #    SLU_DIAG_UNROLL fuses more rank-1 pivot steps per XLA body;
+  #    SLU_LEVEL_MERGE coalesces each etree level's bucket groups
+  #    (-21% post-optimization sequential ops at n=27k for +18%
+  #    real flops at the default limit — near-free if the step is
+  #    op-count-bound); the SLU_TPU_PALLAS arms price the VMEM
+  #    panel-LU kernel IN THE FULL STEP (it loses the isolated
+  #    kernel A/B 0.4-0.5x, but one invocation replaces dozens of
+  #    sequential ops per group); bfloat16 trades 6-pass f32 MXU
+  #    arithmetic for ~3x more refinement sweeps.  Expected ~8
+  #    arms x (cold compile ~4 min + runs) ≈ 40-60 min; hard worst
+  #    case (every arm wedges to its 1200 s timeout) ≈ 2.7 h before
+  #    the sweep starts — accepted: a window where every 27k-class
+  #    compile wedges would not land the sweep's big configs either.
+  #    TPU_AB_CHAIN.jsonl format: each arm appends TWO lines — an
+  #    {"arm": ...} header, then the bench record — unlike
+  #    TPU_AB_TAU.jsonl's bare records (tau arms self-annotate in
+  #    their desc; these env knobs don't reach the desc string —
+  #    except SLU_BENCH_FACTOR_DTYPE and SLU_STAGED, which bench.py
+  #    self-annotates as ' fdt=…' / ' staged').
+  for arm in "SLU_LEVEL_MERGE=1" \
+             "SLU_DIAG_UNROLL=32" \
+             "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32" \
+             "SLU_LEVEL_MERGE=1 SLU_LEVEL_MERGE_LIMIT=4" \
+             "SLU_DIAG_UNROLL=16" \
+             "SLU_TPU_PALLAS=1" \
+             "SLU_TPU_PALLAS=1 SLU_LEVEL_MERGE=1" \
+             "SLU_BENCH_FACTOR_DTYPE=bfloat16"; do
+    ab_tmp=$(mktemp)
+    env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
+      timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
+    rc=$?
+    if grep -q '"cpu_fallback": false' "$ab_tmp"; then
+      { printf '{"arm": "%s"}\n' "$arm"; cat "$ab_tmp"; } \
+        >> "$repo/TPU_AB_CHAIN.jsonl"
+      stamp "chain arm [$arm] rc=$rc (recorded)"
+    else
+      cat "$ab_tmp" >> "$log"
+      stamp "chain arm [$arm] rc=$rc fell back/failed; discarded"
+    fi
+    rm -f "$ab_tmp"
+  done
   # 6. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
@@ -124,21 +161,25 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   SLU_SWEEP_CONFIG_TIMEOUT=${SLU_SWEEP_CONFIG_TIMEOUT:-2400} \
     timeout 9000 python "$repo/bench.py" >> "$log" 2>&1
   stamp "sweep rc=$?"
-  # 7. Pallas on-chip A/B (kernel-level; cheapest to lose).
+  # 7. The n=110,592 profiled step — AFTER the sweep, whose n=110k
+  #    config just compiled/ran it, so the profile is warm; the
+  #    scale regime's op mix differs from n=27k and is where the
+  #    wall/flop question actually lives
+  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r05_k48.json" \
+    timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
+  stamp "profile k48 rc=$?"
+  # 8. Pallas on-chip A/B (kernel-level; cheapest to lose).
   timeout 1800 python "$repo/tools/pallas_ab.py" >> "$log" 2>&1
   stamp "pallas_ab rc=$?"
-  # 8. Amalgamation A/B on the primary config (long windows only —
-  #    each variant recompiles).  The TPU run is latency-bound (MFU
-  #    0.01% measured 2026-08-01): merging supernodes trades cheap
-  #    MXU flops for fewer sequential level steps, and only hardware
-  #    can price that trade.  Compare `best` (wall) across records in
-  #    TPU_AB_TAU.jsonl, not GFLOP/s (flops grow with tau by
-  #    construction).  The 2026-08-01 ladder measured monotone wins
-  #    through tau=400/cap=1024 (0.952→0.815 s; now the accelerator
-  #    default) without finding the knee, so the arms probe PAST the
-  #    default: cap=2048 and tau=800.  A CPU-fallback arm is
-  #    discarded: mixing CPU seconds into the comparison would
-  #    misprice the trade.
+  # 9. Amalgamation A/B on the primary config (long windows only —
+  #    each variant recompiles).  Compare `best` (wall) across
+  #    records in TPU_AB_TAU.jsonl, not GFLOP/s (flops grow with tau
+  #    by construction).  The 2026-08-01 ladder measured monotone
+  #    wins through tau=400/cap=1024 (0.952→0.815 s; now the
+  #    accelerator default) without finding the knee, so the arms
+  #    probe PAST the default: cap=2048 and tau=800.  A CPU-fallback
+  #    arm is discarded: mixing CPU seconds into the comparison
+  #    would misprice the trade.
   for arm in 400:1024 400:2048 800:2048; do
     tau=${arm%%:*}; cap=${arm##*:}
     ab_tmp=$(mktemp)
@@ -152,46 +193,6 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     else
       cat "$ab_tmp" >> "$log"
       stamp "amalg tau=$tau cap=$cap rc=$rc fell back/failed; discarded"
-    fi
-    rm -f "$ab_tmp"
-  done
-  # 9. Sequential-chain arms (the latency-bound hypothesis, round-5
-  #    MFU attack).  SLU_DIAG_UNROLL fuses more rank-1 pivot steps
-  #    per XLA body (chain length wb/unroll per diag block);
-  #    SLU_LEVEL_MERGE collapses each etree level's bucket groups
-  #    into one padded group (35 -> ~11 sequential group bodies at
-  #    n=27k, paying padded flops).  Both are free on the MXU if the
-  #    step really is op-count-bound — only hardware can price them.
-  #    TPU_AB_CHAIN.jsonl format: each arm appends TWO lines — an
-  #    {"arm": ...} header, then the bench record — unlike
-  #    TPU_AB_TAU.jsonl's bare records (tau arms self-annotate in
-  #    their desc; these env knobs don't reach the desc string —
-  #    except SLU_BENCH_FACTOR_DTYPE and SLU_STAGED, which bench.py
-  #    self-annotates as ' fdt=…' / ' staged').
-  #    The SLU_TPU_PALLAS arms price the VMEM panel-LU kernel IN THE
-  #    FULL STEP: it loses the isolated kernel A/B 0.4-0.5x
-  #    (PALLAS_AB.json), but one kernel invocation replaces the
-  #    dozens of sequential XLA ops + fori barriers of the blocked
-  #    LU chain per group — in the latency-bound regime that trade
-  #    can win wall-clock even with slower arithmetic.
-  for arm in "SLU_DIAG_UNROLL=16" "SLU_DIAG_UNROLL=32" \
-             "SLU_LEVEL_MERGE=1" \
-             "SLU_LEVEL_MERGE=1 SLU_LEVEL_MERGE_LIMIT=4" \
-             "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32" \
-             "SLU_TPU_PALLAS=1" \
-             "SLU_TPU_PALLAS=1 SLU_LEVEL_MERGE=1" \
-             "SLU_BENCH_FACTOR_DTYPE=bfloat16"; do
-    ab_tmp=$(mktemp)
-    env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
-      timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
-    rc=$?
-    if grep -q '"cpu_fallback": false' "$ab_tmp"; then
-      { printf '{"arm": "%s"}\n' "$arm"; cat "$ab_tmp"; } \
-        >> "$repo/TPU_AB_CHAIN.jsonl"
-      stamp "chain arm [$arm] rc=$rc (recorded)"
-    else
-      cat "$ab_tmp" >> "$log"
-      stamp "chain arm [$arm] rc=$rc fell back/failed; discarded"
     fi
     rm -f "$ab_tmp"
   done
