@@ -151,7 +151,8 @@ def _load_hw_record(expect_desc: str):
     try:
         with open(_hw_record_path()) as f:
             rec = json.load(f)
-        if rec.get("cpu_fallback") or rec.get("promoted"):
+        if rec.get("cpu_fallback") or rec.get("promoted") \
+                or rec.get("measurement_invalid"):
             return None
         if rec.get("desc") != _hw_key(expect_desc):
             return None
@@ -220,6 +221,15 @@ def _hw_age_text(ts: str) -> str:
         return f"{ts}, {age_s / 86400:.1f}d ago"
     except Exception:
         return ts
+
+
+def _mfu_invalid(gflops: float, peak_tf: float) -> bool:
+    """Plausibility gate: a measured rate above the chip's bf16
+    headline peak (MFU > 100%) is a broken measurement — async
+    dispatch escaping block_until_ready, a clock glitch — never a
+    fast solver.  Gated records are zeroed and stamped MEASUREMENT
+    INVALID; tools/tpu_fire.sh discards them like cpu_fallback arms."""
+    return peak_tf > 0 and gflops > peak_tf * 1e3
 
 
 def _device_peak_tflops(dev) -> float:
@@ -593,10 +603,19 @@ def main():
                   [sys.executable, os.path.abspath(__file__)], env)
 
     mfu_txt = ""
+    mfu_invalid = False
     if peak_tf > 0:
         mfu = r["gflops"] / (peak_tf * 1e3) * 100.0
         mfu_txt = (f"; {getattr(dev, 'device_kind', dev.platform)} MFU "
                    f"{mfu:.2f}% of bf16 peak")
+        if _mfu_invalid(r["gflops"], peak_tf):
+            # the SLU_DIAG_UNROLL=32 arm once "measured" 165% MFU
+            # (6.4e-5 s wall); zero the value so no consumer can
+            # promote or headline such a line
+            mfu_invalid = True
+            mfu_txt += ("; MEASUREMENT INVALID: implied MFU exceeds "
+                        "100% of bf16 peak")
+    ok = r["accuracy_ok"] and not mfu_invalid
     true_txt = ""
     if r.get("true_gflops") is not None:
         true_txt = (f"; executed flops incl. amalgamation padding — "
@@ -615,19 +634,20 @@ def main():
                   + (f"; CPU FALLBACK (accelerator unreachable: "
                      f"{fb_reason})" if cpu_fallback else "")
                   + ")",
-        "value": round(r["gflops"], 3) if r["accuracy_ok"] else 0.0,
+        "value": round(r["gflops"], 3) if ok else 0.0,
         "unit": "GFLOP/s",
         "vs_baseline": (round(r["t_scipy"] / r["best"], 3)
-                        if r["accuracy_ok"] else 0.0),
+                        if ok else 0.0),
         "cpu_fallback": cpu_fallback,
     }
+    if mfu_invalid:
+        line["measurement_invalid"] = True
     primary_mode = os.environ.get("SLU_BENCH_EMIT_RECORD") != "1"
     # EMIT_RECORD mode = sweep child or A/B arm: its config (k, nrhs,
     # tau) differs from the primary's, so it must neither overwrite
     # the promotable primary record nor promote one into its output
     # (the raw `record` line is what its consumer parses)
-    if primary_mode and on_accel and not cpu_fallback \
-            and r["accuracy_ok"]:
+    if primary_mode and on_accel and not cpu_fallback and ok:
         # a live window landed a hardware number: stamp the contract
         # line itself (ts + config key + code version) so the stdout
         # line IS a valid promotable record, then persist it; the
@@ -685,7 +705,8 @@ def main():
         print(json.dumps(dict(
             r, record=True, platform=dev.platform,
             device_kind=getattr(dev, "device_kind", ""),
-            cpu_fallback=cpu_fallback)))
+            cpu_fallback=cpu_fallback,
+            **({"measurement_invalid": True} if mfu_invalid else {}))))
         sys.stdout.flush()
 
     if os.environ.get("SLU_BENCH_SWEEP") == "1":

@@ -5,7 +5,14 @@ psgsrfs_d2 mixed-precision strategy when the factorization ran in a
 lower precision, SRC/psgsrfs_d2.c:229), solve A·δ = r with the existing
 factorization, x += δ, until the componentwise backward error `berr`
 stops improving (same stopping rule family as the reference: stop when
-berr < eps or improvement < 2×)."""
+berr < eps or improvement < 2×).
+
+This is the HOST loop (scipy CSR residuals — already scatter-free).
+The fused device solver runs the same decisions on device with the
+padded-ELL residual SpMV (`ops/spmv.py`; scatter-free by
+construction, `SLU_SPMV_LAYOUT` selects) inside one XLA while_loop —
+`ops/batched.make_fused_solver` mirrors this loop's semantics and the
+two must not diverge."""
 
 from __future__ import annotations
 

@@ -117,6 +117,16 @@ class GroupSpec:
     U_off: int
     Li_off: int
     Ui_off: int
+    # BLOCK-COPY extend-add lane (the scatter-free fast path): children
+    # whose position vector decomposes into a few long contiguous runs
+    # move as 2-D dynamic_slice → dynamic_update_slice block copies
+    # instead of element gather/scatter (TPU_PROFILE_r05: the element
+    # fusions run at 50–200 MB/s; contiguous copies run at HBM rate).
+    # Per bucket key (li, lj, st): (so, dr, dc, w) stacked (ndev, K) —
+    # source flat offset, dest block row/col in the (n_pad·mb, ncols)
+    # front view, and a 0/1 mask killing K-padding records.
+    eb_hosts: tuple = ()
+    eb_meta: tuple = ()        # per-bucket (li, lj, st, K) statics
     # False when every front's parent lives on the same device (zone-
     # affine placement): the update slab then skips its all_gather and
     # each device writes only its local slice — the gather-free
@@ -148,8 +158,10 @@ class GroupSpec:
     def dev(self, squeeze: bool, with_a_src: bool = True):
         """Device copies of the index arrays (cached per key).
         squeeze=True drops the leading ndev=1 axis for the
-        single-device path.  Position 3 is the ea-block pytree (tuple
-        of per-bucket 4-tuples).  with_a_src=False leaves position 0
+        single-device path.  Position 3 is the extend-add pytree: a
+        pair (elem_buckets, block_buckets) — element-gather buckets
+        (per-bucket 5-tuples) and block-copy buckets (per-bucket
+        4-tuples, eb_hosts).  with_a_src=False leaves position 0
         as None — for callers that substitute a remapped a_src
         (factor_dist._sharded_factor_operands), so the global array is
         never uploaded or cached."""
@@ -175,6 +187,18 @@ class GroupSpec:
                                 prd,
                                 prd if pc is pr
                                 else jnp.asarray(pc, dtype=jnp.int32)))
+            bblocks = []
+            for (li, lj, st, K), (so, dr, dc, w) in zip(
+                    self.eb_meta, self.eb_hosts):
+                # dynamic_slice offsets need no gather-wrap dtype
+                # promotion, but must hold the largest start value
+                bdt = (jnp.int32
+                       if int(so.max(initial=0)) + li * st < 2**31 - 1
+                       else jnp.int64)
+                bblocks.append((jnp.asarray(so, dtype=bdt),
+                                jnp.asarray(dr, dtype=jnp.int32),
+                                jnp.asarray(dc, dtype=jnp.int32),
+                                jnp.asarray(w, dtype=jnp.int32)))
             pos = (self.pos_of_slot if self.pos_of_slot is not None
                    else np.zeros((self.a_src.shape[0], 1, 1),
                                  dtype=np.int32))
@@ -183,7 +207,7 @@ class GroupSpec:
                 else None,
                 jnp.asarray(self.a_dst, dtype=fdt),
                 jnp.asarray(self.one_dst, dtype=fdt),
-                tuple(eblocks),
+                (tuple(eblocks), tuple(bblocks)),
                 jnp.asarray(pos, dtype=jnp.int32),
                 jnp.asarray(self.col_idx, dtype=jnp.int32),
                 jnp.asarray(self.struct_idx, dtype=jnp.int32),
@@ -205,6 +229,13 @@ class BatchedSchedule:
     Li_total: int
     Ui_total: int
     sup_dev: np.ndarray = None  # front -> device placement
+    # tail padding of the update slab (in elements): the block-copy
+    # extend-add lane reads each (li, lj) sub-block as one (li·st)
+    # dynamic_slice whose final row over-reads up to st−lj elements
+    # past the child slab; the pad guarantees the slice never clamps
+    # (a clamped dynamic_slice silently SHIFTS its window).  1 when no
+    # block lane exists (the legacy +1 sentinel slot).
+    upd_pad: int = 1
 
     def comm_summary(self, dtype=np.float64, nrhs: int = 1) -> dict:
         """Static per-step collective traffic (the SCT_t comm-volume
@@ -374,6 +405,58 @@ def _coalesce_buckets(by_bucket: dict, limit: float) -> dict:
     return merged
 
 
+def _ea_block_on() -> bool:
+    """Block-copy extend-add lane (SLU_EA_BLOCK, default ON): children
+    whose extend-add position maps are a few long contiguous runs move
+    as dynamic_slice/dynamic_update_slice 2-D block copies instead of
+    element gather/scatter — the answer to TPU_PROFILE_r05's
+    50–200 MB/s slab↔GEMM-buffer fusions.  =0 restores the pure
+    element formulation for A/B."""
+    import os
+    return os.environ.get("SLU_EA_BLOCK", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _ea_block_min_run() -> int:
+    """Minimum contiguous-run length for the block lane
+    (SLU_EA_BLOCK_MIN_RUN, default 8): shorter runs stay on the
+    element path, where per-copy dispatch would dominate."""
+    import os
+    try:
+        return max(2, int(os.environ.get("SLU_EA_BLOCK_MIN_RUN", "8")))
+    except ValueError:
+        return 8
+
+
+def _contig_runs(pos) -> list:
+    """Maximal runs of consecutive (+1-stepping) values in `pos`:
+    [(start_index, length), ...] covering the whole vector."""
+    pos = np.asarray(pos)
+    if len(pos) == 0:
+        return []
+    brk = np.flatnonzero(np.diff(pos) != 1)
+    starts = np.concatenate([[0], brk + 1])
+    ends = np.concatenate([brk + 1, [len(pos)]])
+    return [(int(s), int(e - s)) for s, e in zip(starts, ends)]
+
+
+def _plan_child_blocks(ps_row, min_run: int | None = None,
+                       max_runs: int = 4):
+    """Block-copy eligibility of one child's extend-add position
+    vector: the run list [(i0, len)] when EVERY maximal run is ≥
+    min_run and there are ≤ max_runs of them (the rc×rc update then
+    moves as nruns² contiguous 2-D block copies), else None (the
+    child stays on the element-gather path — the ragged remainder)."""
+    if min_run is None:
+        min_run = _ea_block_min_run()
+    runs = _contig_runs(ps_row)
+    if not runs or len(runs) > max_runs:
+        return None
+    if any(ln < min_run for _, ln in runs):
+        return None
+    return runs
+
+
 def _coop_mb_min() -> int:
     """Minimum padded front size for cooperative (column-sharded)
     factorization; SLU_COOP_MB overrides, 0 disables."""
@@ -439,6 +522,10 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     sup_dev = np.zeros(fp.nsuper, dtype=np.int64)
     coop_sup = np.zeros(fp.nsuper, dtype=bool)
     coop_min = _coop_mb_min()
+
+    block_on = _ea_block_on()
+    blk_min_run = _ea_block_min_run()
+    max_blk_stride = 0           # sizes the upd-slab tail pad
 
     sup_upd_off = np.full(fp.nsuper, -1, dtype=np.int64)
     # actual slab row/col stride each front was WRITTEN with — its
@@ -644,6 +731,8 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
             # extend-add child records, outer-product form: per child
             # only (rc, slab offset, slab stride, front base, positions)
             child_recs = [[] for _ in range(ndev)]
+            # block-copy records (li, lj, st, src_off, dst_row, dst_col)
+            blk_recs = [[] for _ in range(ndev)]
             col_idx = np.full((ndev, n_loc, wb), n, dtype=np.int64)
             struct_idx = np.full((ndev, n_loc, rb), n, dtype=np.int64)
 
@@ -696,9 +785,29 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                             # non-sharded parent cannot occur — coop is
                             # forced up the chain)
                             assert not sharded_sup[int(c)]
-                            child_recs[d].append(
-                                (rc, int(coff), rbc, base,
-                                 ps_row, ps_row, rc))
+                            runs = (_plan_child_blocks(
+                                        ps_row, min_run=blk_min_run)
+                                    if block_on else None)
+                            if runs is not None:
+                                # run × run sub-blocks of the rc×rc
+                                # update move as contiguous 2-D copies
+                                # (slab rows are vector-index order at
+                                # stride rbc; dest rows/cols are the
+                                # run's front positions)
+                                max_blk_stride = max(max_blk_stride,
+                                                     int(rbc))
+                                for (i0, li) in runs:
+                                    for (j0, lj) in runs:
+                                        blk_recs[d].append(
+                                            (li, lj, int(rbc),
+                                             int(coff) + i0 * rbc + j0,
+                                             base // ncols
+                                             + int(ps_row[i0]),
+                                             int(ps_row[j0])))
+                            else:
+                                child_recs[d].append(
+                                    (rc, int(coff), rbc, base,
+                                     ps_row, ps_row, rc))
                         elif sharded_sup[int(c)]:
                             # device-local child slice (rbc, tp_c):
                             # owned columns align with this device's
@@ -798,8 +907,45 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                         pr[d, i, :rc] = ps_row
                         if sharded:
                             pc[d, i, :tc] = ps_col
+                    # K-padding records repeat the LAST real dst_base:
+                    # their positions are all-sentinel (dropped) so db
+                    # is semantically dead on the element path, but the
+                    # Pallas scatter engine's output-block schedule
+                    # requires db monotone per device (a 0 would
+                    # revisit front 0 out of order and overwrite its
+                    # accumulated delta)
+                    nreal = len(per_d[d])
+                    if 0 < nreal < K:
+                        db[d, nreal:] = db[d, nreal - 1]
                 ea_hosts.append((so, st, db, pr, pc))
                 ea_meta.append((rc_b, tc_b, K, C))
+
+            # bucket the block-copy records by exact (li, lj, stride):
+            # every record in a bucket shares its slice shapes, so one
+            # fori_loop of uniform dynamic_slice copies serves the
+            # bucket; K pads to the size grid with masked no-ops
+            by_blk: dict = {}
+            for d in range(ndev):
+                for rec in blk_recs[d]:
+                    by_blk.setdefault(
+                        rec[:3], [[] for _ in range(ndev)])[d].append(rec)
+            eb_hosts, eb_meta = [], []
+            for (bli, blj, bst) in sorted(by_blk):
+                per_d = by_blk[(bli, blj, bst)]
+                K = _next_bucket(max(len(v) for v in per_d))
+                so = np.zeros((ndev, K), dtype=np.int64)
+                dr = np.zeros((ndev, K), dtype=np.int64)
+                dc = np.zeros((ndev, K), dtype=np.int64)
+                wm = np.zeros((ndev, K), dtype=np.int64)
+                for d in range(ndev):
+                    for i, (_, _, _, soff, drow,
+                            dcol) in enumerate(per_d[d]):
+                        so[d, i] = soff
+                        dr[d, i] = drow
+                        dc[d, i] = dcol
+                        wm[d, i] = 1
+                eb_hosts.append((so, dr, dc, wm))
+                eb_meta.append((bli, blj, bst, K))
 
             def stack(key, fill, distinct_pad=False):
                 """distinct_pad gives every padding slot its own
@@ -830,6 +976,7 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                 a_dst=stack("a_dst", f_loc, distinct_pad=True),
                 one_dst=stack("one", f_loc, distinct_pad=True),
                 ea_hosts=tuple(ea_hosts), ea_meta=tuple(ea_meta),
+                eb_hosts=tuple(eb_hosts), eb_meta=tuple(eb_meta),
                 col_idx=col_idx, struct_idx=struct_idx,
                 upd_off_global=upd_off,
                 L_off=L_cur, U_off=U_cur, Li_off=Li_cur, Ui_off=Ui_cur,
@@ -908,7 +1055,8 @@ def build_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
                            upd_total=upd_peak,
                            L_total=L_cur, U_total=U_cur,
                            Li_total=Li_cur, Ui_total=Ui_cur,
-                           sup_dev=sup_dev)
+                           sup_dev=sup_dev,
+                           upd_pad=1 + max_blk_stride)
 
 
 def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
@@ -921,7 +1069,8 @@ def get_schedule(plan: FactorPlan, ndev: int = 1) -> BatchedSchedule:
     key = (ndev, (_coop_mb_min(), _coop_sharded_on(), _coop_block(),
                   _coop_solve_rotate())
            if ndev > 1 else 0,
-           _level_merge_limit() if _level_merge_on() else None)
+           _level_merge_limit() if _level_merge_on() else None,
+           (_ea_block_min_run() if _ea_block_on() else None))
     if key not in cache:
         cache[key] = build_schedule(plan, ndev)
     return cache[key]
@@ -1018,7 +1167,7 @@ def psum_exact(x, axis):
 
 
 def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int,
-            ncols: int = 0):
+            ncols: int = 0, allow_pallas: bool = True):
     """Extend-add of child update blocks into the flat front batch F.
     Outer-product form: per child only its O(rc) position vectors ship
     from the host; the rc·tc flat indices are iota arithmetic on
@@ -1029,10 +1178,21 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int,
 
     `ncols` is the front's column count (mb for the square layout;
     cp for sharded-coop owned-column slices, whose destination column
-    index is an owned SLOT from the separate pos_col vector)."""
+    index is an owned SLOT from the separate pos_col vector).
+
+    With SLU_TPU_PALLAS_SCATTER=1 (ops/pallas_scatter) the scatter
+    side of eligible buckets runs as the tiled Pallas scatter engine
+    (the dsuperlu_gpu.cu:115-143 analog): per-child one-hot expansion
+    on the MXU accumulating into per-front VMEM tiles — priced as a
+    fire-plan chain arm before any default flips."""
     if not ncols:
         ncols = mb
     f_loc = n_pad * mb * ncols
+    from . import pallas_scatter
+    # pair mode traces this under vmap, where a pallas_call's batching
+    # rule is not a path we certify — the plane loop keeps the element
+    # scatter there (allow_pallas=False from _factor_group_impl_pair)
+    use_ps = allow_pallas and pallas_scatter.enabled(F.dtype)
 
     for (rc_b, tc_b, K, C), (so, st, db, pr, pc) in zip(ea_meta,
                                                         ea_blocks):
@@ -1056,6 +1216,19 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int,
                    + ai[None, :, None] * st[:, None, None]
                    + aj[None, None, :]).reshape(-1)
             upd = upd_buf[src]
+            if use_ps and pallas_scatter.usable(mb, ncols, rc_b, tc_b,
+                                                upd.dtype):
+                # scatter engine: the gather above still feeds it, but
+                # the serialized element scatter becomes MXU one-hot
+                # accumulation into per-front VMEM tiles (records are
+                # front-sorted by the schedule builder; sentinel
+                # positions mb/ncols one-hot to zero rows — dropped)
+                fb = (db // (mb * ncols)).astype(jnp.int32)
+                delta = pallas_scatter.scatter_add_delta(
+                    upd.reshape(-1, rc_b, tc_b),
+                    pr.astype(jnp.int32), pc.astype(jnp.int32), fb,
+                    mb=mb, ncols=ncols, n_pad=n_pad)
+                return Ff + delta.reshape(-1)
             pi = pr[:, :, None].astype(db.dtype)
             pj = pc[:, None, :].astype(db.dtype)
             dst = db[:, None, None] + pi * ncols + pj
@@ -1082,11 +1255,49 @@ def _ea_add(F, upd_buf, ea_blocks, ea_meta, *, mb: int, n_pad: int,
     return F
 
 
+def _ea_add_blocks(F, upd_buf, eb_blocks, eb_meta, *, mb: int,
+                   n_pad: int, ncols: int = 0):
+    """Block-copy extend-add lane (GroupSpec.eb_hosts): each record is
+    one contiguous (li, lj) sub-block of a child update, moved as a
+    dynamic_slice read (li·st flat elements reshaped to rows, over-read
+    tail discarded; BatchedSchedule.upd_pad guarantees no clamp) and a
+    read-add-dynamic_update_slice write into the (n_pad·mb, ncols)
+    front view.  Sequential within a bucket (fori_loop), so overlapping
+    destination blocks accumulate correctly; `w` masks K-padding
+    records to no-ops (their in-bounds dst gets +0)."""
+    if not eb_meta:
+        return F
+    if not ncols:
+        ncols = mb
+    F2 = F.reshape(n_pad * mb, ncols)
+    for (li, lj, st, K), (so, dr, dc, w) in zip(eb_meta, eb_blocks):
+        if upd_buf.size > np.iinfo(np.dtype(so.dtype)).max:
+            # >2^31-element slabs: the clamp arithmetic of
+            # dynamic_slice must not wrap in the index dtype (same
+            # audikw-class guard as _ea_add's gather promotion)
+            so = so.astype(jnp.int64)
+
+        def copy_one(i, F2, so=so, dr=dr, dc=dc, w=w,
+                     li=li, lj=lj, st=st):
+            src = jax.lax.dynamic_slice(upd_buf, (so[i],), (li * st,))
+            blk = src.reshape(li, st)[:, :lj]
+            mask = w[i].astype(F2.dtype)
+            cur = jax.lax.dynamic_slice(F2, (dr[i], dc[i]), (li, lj))
+            return jax.lax.dynamic_update_slice(
+                F2, cur + mask * blk, (dr[i], dc[i]))
+
+        if K == 1:
+            F2 = copy_one(0, F2)
+        else:
+            F2 = jax.lax.fori_loop(0, K, copy_one, F2)
+    return F2.reshape(-1)
+
+
 def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        tiny, nzero, thresh, a_src, a_dst, one_dst,
                        ea_blocks, upd_off, L_off, U_off, Li_off,
                        Ui_off, *, mb: int, wb: int, n_pad: int,
-                       ea_meta: tuple = (),
+                       ea_meta: tuple = (), eb_meta: tuple = (),
                        axis: Optional[str] = None,
                        gather: bool = True, coop: bool = False,
                        ndev: int = 1, pos_idx=None, cp: int = 0,
@@ -1096,11 +1307,14 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
             nzero, thresh, a_src, a_dst, one_dst, ea_blocks, upd_off,
             L_off, U_off, Li_off, Ui_off, mb=mb, wb=wb, n_pad=n_pad,
-            ea_meta=ea_meta, axis=axis, coop=coop)
+            ea_meta=ea_meta, eb_meta=eb_meta, axis=axis, coop=coop)
     dtype = L_flat.dtype
     one = jnp.ones((), dtype)
     sharded = coop and axis is not None and cp > 0
     ncols = cp if sharded else mb
+    # position 3 carries both extend-add lanes: element-gather buckets
+    # and contiguous block-copy buckets (GroupSpec.dev docstring)
+    elem_blocks, blk_blocks = ea_blocks
     F = jnp.zeros(n_pad * mb * ncols, dtype)
     # a_dst/one_dst carry DISTINCT out-of-bounds padding, so the
     # unique-indices promise holds; add-scatter index pairs are
@@ -1109,8 +1323,10 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[a_dst].add(vals[a_src], mode="drop",
                         unique_indices=True, indices_are_sorted=True)
     F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
-    F = _ea_add(F, upd_buf, ea_blocks, ea_meta, mb=mb, n_pad=n_pad,
+    F = _ea_add(F, upd_buf, elem_blocks, ea_meta, mb=mb, n_pad=n_pad,
                 ncols=ncols)
+    F = _ea_add_blocks(F, upd_buf, blk_blocks, eb_meta, mb=mb,
+                       n_pad=n_pad, ncols=ncols)
     F = F.reshape(n_pad, mb, ncols)
 
     if sharded:
@@ -1192,6 +1408,7 @@ def _factor_group_impl_pair(vals, upd_buf, L_flat, U_flat, Li_flat,
                             a_dst, one_dst, ea_blocks, upd_off, L_off,
                             U_off, Li_off, Ui_off, *, mb: int,
                             wb: int, n_pad: int, ea_meta: tuple = (),
+                            eb_meta: tuple = (),
                             axis: Optional[str] = None,
                             coop: bool = False):
     """_factor_group_impl on stacked real/imag planes (ops/pair_lu):
@@ -1221,10 +1438,14 @@ def _factor_group_impl_pair(vals, upd_buf, L_flat, U_flat, Li_flat,
                             indices_are_sorted=True)
         return f.at[one_dst].set(o, mode="drop", unique_indices=True)
 
+    elem_blocks, blk_blocks = ea_blocks
     F = jax.vmap(assemble)(jnp.zeros((2, n_pad * mb * ncols), rdt),
                            vals, one_pl)
     F = jax.vmap(lambda f, u: _ea_add(
-        f, u, ea_blocks, ea_meta, mb=mb, n_pad=n_pad,
+        f, u, elem_blocks, ea_meta, mb=mb, n_pad=n_pad,
+        ncols=ncols, allow_pallas=False))(F, upd_buf)
+    F = jax.vmap(lambda f, u: _ea_add_blocks(
+        f, u, blk_blocks, eb_meta, mb=mb, n_pad=n_pad,
         ncols=ncols))(F, upd_buf)
     F = F.reshape(2, n_pad, mb, ncols)
     F, tiny_g, nzero_g = partial_lu_pair_batch(F, thresh, wb=wb)
@@ -1462,12 +1683,12 @@ def staged_enabled(sched) -> bool:
 
 @functools.partial(jax.jit,
                    static_argnames=("mb", "wb", "n_pad", "ea_meta",
-                                    "pair"),
+                                    "eb_meta", "pair"),
                    donate_argnums=(0,))
 def _staged_factor_group(upd_buf, vals, thresh, a_src, a_dst, one_dst,
                          ea_blocks, upd_off, *, mb: int, wb: int,
                          n_pad: int, ea_meta: tuple,
-                         pair: bool = False):
+                         eb_meta: tuple = (), pair: bool = False):
     """One factor group as its own program: group-LOCAL panel outputs
     (offset 0 into exact-size flats) instead of writes into the global
     slabs; `upd_buf` is donated so the extend-add buffer streams
@@ -1484,7 +1705,8 @@ def _staged_factor_group(upd_buf, vals, thresh, a_src, a_dst, one_dst,
             jnp.zeros(lead + (n_pad * wb * wb,), dtype),
             z32, z32, thresh, a_src, a_dst, one_dst, ea_blocks,
             upd_off, z32, z32, z32, z32,
-            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta, pair=pair)
+            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
+            eb_meta=eb_meta, pair=pair)
 
 
 @functools.partial(jax.jit,
@@ -1529,10 +1751,10 @@ def _staged_factor_run(sched, vals, thresh_np, dtype,
     rdt = _real_dtype(dtype)
     if pair:
         vals_ext = _vals_ext_pair(vals, rdt.str)
-        upd_buf = jnp.zeros((2, sched.upd_total + 1), rdt)
+        upd_buf = jnp.zeros((2, sched.upd_total + sched.upd_pad), rdt)
     else:
         vals_ext = _vals_ext(vals, dtype.str)
-        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+        upd_buf = jnp.zeros(sched.upd_total + sched.upd_pad, dtype)
     thresh = jnp.asarray(thresh_np, dtype=rdt)
     panels = []
     tiny = nzero = jnp.zeros((), jnp.int32)
@@ -1542,7 +1764,7 @@ def _staged_factor_run(sched, vals, thresh_np, dtype,
             upd_buf, vals_ext, thresh, a_src, a_dst, one_dst,
             ea_blocks, jnp.asarray(g.upd_off_global, jnp.int64),
             mb=g.mb, wb=g.wb, n_pad=g.n_loc, ea_meta=g.ea_meta,
-            pair=pair)
+            eb_meta=g.eb_meta, pair=pair)
         panels.append((L, U, Li, Ui))
         tiny = tiny + t
         nzero = nzero + z
@@ -1778,7 +2000,7 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
         thresh = jnp.asarray(thresh_np, dtype=_real_dtype(dtype))
         vals = jnp.concatenate(
             [vals.astype(dtype), jnp.zeros(1, dtype)])
-        upd_buf = jnp.zeros(sched.upd_total + 1, dtype)
+        upd_buf = jnp.zeros(sched.upd_total + sched.upd_pad, dtype)
         L_flat = jnp.zeros(sched.L_total, dtype)
         U_flat = jnp.zeros(sched.U_total, dtype)
         Li_flat = jnp.zeros(sched.Li_total, dtype)
@@ -1796,7 +2018,7 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
                     jnp.int32(g.L_off), jnp.int32(g.U_off),
                     jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
                     mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                    ea_meta=g.ea_meta)
+                    ea_meta=g.ea_meta, eb_meta=g.eb_meta)
         # promote rather than cast: a complex rhs against a real
         # factor must stay complex (matches solve_device)
         xdt = jnp.promote_types(dtype, b.dtype)
@@ -1859,7 +2081,8 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     if staged and mesh is not None:
         raise ValueError("staged=True is single-device only; mesh "
                          "execution always uses the fused program")
-    from .spmv import coo_spmv
+    from .spmv import (coo_spmv, ell_cols_from_src, ell_from_csr,
+                       ell_spmv, spmv_layout)
 
     from ..options import IterRefine
 
@@ -1921,6 +2144,26 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         coo_cols=jnp.asarray(plan.coo_cols, dtype=idt),
     )
 
+    # ---- residual-SpMV layout: padded ELL by default — per-row
+    # gather of a fixed band + row-sum, so the jitted refinement
+    # residual lowers with ZERO scatter ops (the COO scatter-add ran
+    # at ~600 MB/s on v5e, ~140 ms/step over the IR iterations;
+    # TPU_PROFILE_r05.json fusion.14932/14936).  plan COO order IS CSR
+    # row-major order (sparse.CSRMatrix.to_coo), so row boundaries
+    # reconstruct from the row ids; SLU_SPMV_LAYOUT=coo restores the
+    # scatter formulation for A/B ----
+    nnz_a = len(plan.coo_rows)
+    _rc_counts = np.bincount(np.asarray(plan.coo_rows), minlength=n)
+    _indptr_a = np.concatenate([[0], np.cumsum(_rc_counts)])
+    ell_src_np, ell_w = ell_from_csr(_indptr_a, plan.coo_cols,
+                                     nnz=nnz_a)
+    layout = spmv_layout(nnz_a, n, ell_w)
+    if layout == "ell":
+        sdt_e = jnp.int32 if nnz_a < 2**31 - 1 else jnp.int64
+        ops["ell_src"] = jnp.asarray(ell_src_np, dtype=sdt_e)
+        ops["ell_cols"] = jnp.asarray(
+            ell_cols_from_src(ell_src_np, plan.coo_cols, n), dtype=idt)
+
     # ---- shared numerics pieces: ONE definition serves the fused
     # trace and the staged host loop, so the two cannot diverge ----
 
@@ -1954,6 +2197,13 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         denom = jnp.where(denom == 0, 1, denom)
         return r, jnp.max(jnp.abs(r) / denom)
 
+    def _ell_plane(v):
+        """Runtime values -> padded ELL value plane (pad slots hit the
+        appended zero).  Loop-invariant in the refinement while_loop —
+        XLA's invariant code motion hoists it out of the body."""
+        return jnp.concatenate(
+            [v, jnp.zeros(1, v.dtype)])[ops["ell_src"]]
+
     def _resid_berr_impl(vals_r, abs_vals, b, xv):
         if pair:
             # pair SpMV: A and x in plane form — the product is four
@@ -1962,20 +2212,37 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             h = xv.shape[1] // 2
             xr, xi = xv[:, :h], xv[:, h:]
 
-            def sp(v, x):
-                return coo_spmv(ops["coo_rows"], ops["coo_cols"],
-                                v, x, n)
+            if layout == "ell":
+                er, ei = _ell_plane(vals_r[0]), _ell_plane(vals_r[1])
+                ea = _ell_plane(abs_vals)
 
-            ax = jnp.concatenate(
-                [sp(vals_r[0], xr) - sp(vals_r[1], xi),
-                 sp(vals_r[0], xi) + sp(vals_r[1], xr)], axis=1)
-            den = sp(abs_vals, jnp.sqrt(xr * xr + xi * xi))
+                def spr(ev, x):
+                    return ell_spmv(ops["ell_cols"], ev, x)
+
+                ax = jnp.concatenate(
+                    [spr(er, xr) - spr(ei, xi),
+                     spr(er, xi) + spr(ei, xr)], axis=1)
+                den = spr(ea, jnp.sqrt(xr * xr + xi * xi))
+            else:
+                def sp(v, x):
+                    return coo_spmv(ops["coo_rows"], ops["coo_cols"],
+                                    v, x, n)
+
+                ax = jnp.concatenate(
+                    [sp(vals_r[0], xr) - sp(vals_r[1], xi),
+                     sp(vals_r[0], xi) + sp(vals_r[1], xr)], axis=1)
+                den = sp(abs_vals, jnp.sqrt(xr * xr + xi * xi))
             r = b - ax
             rmod = jnp.sqrt(r[:, :h] ** 2 + r[:, h:] ** 2)
             bmod = jnp.sqrt(b[:, :h] ** 2 + b[:, h:] ** 2)
             denom = den + bmod
             denom = jnp.where(denom == 0, 1, denom)
             return r, jnp.max(rmod / denom)
+        if layout == "ell":
+            ax = ell_spmv(ops["ell_cols"], _ell_plane(vals_r), xv)
+            den = ell_spmv(ops["ell_cols"], _ell_plane(abs_vals),
+                           jnp.abs(xv))
+            return _combine_resid(b, ax, den)
         ax = coo_spmv(ops["coo_rows"], ops["coo_cols"], vals_r, xv, n)
         den = coo_spmv(ops["coo_rows"], ops["coo_cols"],
                        abs_vals, jnp.abs(xv), n)
@@ -1988,6 +2255,14 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return jnp.sqrt(vals_r[0] * vals_r[0]
                             + vals_r[1] * vals_r[1])
         return jnp.abs(vals_r)
+
+    def _resid_fn(vals, b, x):
+        """Introspection/test surface: the refinement residual+berr
+        exactly as the step's loop body computes it (jittable; the
+        HLO no-scatter contract in ELL mode is pinned on this)."""
+        vals_r = vals.astype(rrdt if pair else rdt)
+        return _resid_berr_impl(vals_r, _abs_impl(vals_r),
+                                b.astype(rrdt if pair else rdt), x)
 
     def _factor(scaled_vals, per_group):
         # the group-loop drivers are factor_dist's — ONE implementation
@@ -2144,7 +2419,10 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                     jnp.asarray(max(steps - 1, 0), jnp.int32),
                     t32, z32)
 
-        return _wrap_pair(step)
+        step = _wrap_pair(step)
+        step.resid_fn = _resid_fn
+        step.spmv_layout = layout
+        return step
 
     if mesh is None:
         per_group_const = [g.dev(squeeze=True) for g in sched.groups]
@@ -2161,7 +2439,10 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return step_body(_scale_impl(vals), resid_berr, b_r,
                              per_group_const)
 
-        return _wrap_pair(step)
+        step = _wrap_pair(step)
+        step.resid_fn = _resid_fn
+        step.spmv_layout = layout
+        return step
 
     # mesh execution: group index arrays enter as sharded operands,
     # and so does the NUMERIC INPUT (NRformat_loc, supermatrix.h:
@@ -2177,6 +2458,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     from ..parallel.factor_dist import (_group_operands, _regroup,
                                         _shard_vals,
                                         _sharded_factor_operands)
+    from ..utils.compat import shard_map as _shard_map
 
     if not _shard_vals(dtype):
         # complex: keep the round-3 replicated formulation — the
@@ -2197,7 +2479,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return step_body(_scale_impl(vals), resid_berr, b_r,
                              _regroup(sched, idx_flat, 7))
 
-        mapped_c = jax.shard_map(
+        mapped_c = _shard_map(
             mapped_body_c, mesh=mesh,
             in_specs=(P(), P()) + idx_specs,
             out_specs=(P(), P(), P(), P(), P()),
@@ -2222,17 +2504,50 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     from jax.sharding import NamedSharding
     row_shard = NamedSharding(mesh, P(axis))
     scale_sel = jax.device_put(scale_fac_np[sel], row_shard)
-    # contiguous nnz chunks for the residual SpMV; pad entries carry
-    # index n — coo_spmv's drop sentinel
-    chunk = -(-nnz // ndev)
-    pad = ndev * chunk - nnz
     cdt = np.int64 if n >= 2**31 - 1 else np.int32
-    rows_c = jax.device_put(
-        np.pad(np.asarray(plan.coo_rows), (0, pad), constant_values=n)
-        .reshape(ndev, chunk).astype(cdt), row_shard)
-    cols_c = jax.device_put(
-        np.pad(np.asarray(plan.coo_cols), (0, pad), constant_values=n)
-        .reshape(ndev, chunk).astype(cdt), row_shard)
+    if layout == "ell":
+        # scatter-free mesh residual: ROW-partitioned padded ELL.
+        # CSR rows are contiguous in plan COO order, so a row split is
+        # a contiguous value-slice split; each device computes its own
+        # row block y-slice (pure gather + rowsum), places it at its
+        # row offset with ONE dynamic_update_slice, and the psum
+        # assembles the full vector — no scatter anywhere.
+        rchunk = -(-n // ndev)
+        vmax = max(int((_indptr_a[min(n, (d + 1) * rchunk)]
+                        - _indptr_a[min(n, d * rchunk)]))
+                   for d in range(ndev))
+        vmax = max(vmax, 1)
+        vsel_r = np.zeros((ndev, vmax), dtype=np.int64)
+        esl = np.full((ndev, rchunk, ell_w), vmax, dtype=np.int64)
+        ecl = np.full((ndev, rchunk, ell_w), n, dtype=np.int64)
+        for d in range(ndev):
+            r0 = min(n, d * rchunk)
+            r1 = min(n, (d + 1) * rchunk)
+            v0, v1 = int(_indptr_a[r0]), int(_indptr_a[r1])
+            vsel_r[d, :v1 - v0] = np.arange(v0, v1)
+            loc = ell_src_np[r0:r1]           # global src, pad → nnz
+            esl[d, :r1 - r0] = np.where(loc < nnz, loc - v0, vmax)
+            ecl[d, :r1 - r0] = ell_cols_from_src(
+                loc, plan.coo_cols, n)
+        es_c = jax.device_put(
+            esl.astype(np.int64 if vmax >= 2**31 - 1 else np.int32),
+            row_shard)
+        ec_c = jax.device_put(ecl.astype(cdt), row_shard)
+        vpad_host = vsel_r
+    else:
+        # contiguous nnz chunks for the COO residual SpMV; pad
+        # entries carry index n — coo_spmv's drop sentinel
+        chunk = -(-nnz // ndev)
+        pad = ndev * chunk - nnz
+        rows_c = jax.device_put(
+            np.pad(np.asarray(plan.coo_rows), (0, pad),
+                   constant_values=n)
+            .reshape(ndev, chunk).astype(cdt), row_shard)
+        cols_c = jax.device_put(
+            np.pad(np.asarray(plan.coo_cols), (0, pad),
+                   constant_values=n)
+            .reshape(ndev, chunk).astype(cdt), row_shard)
+        es_c, ec_c = rows_c, cols_c           # positional slot reuse
 
     def mapped_body(vals_sel, ssel, vals_chunk, rc, cc, b, *idx_flat):
         # every per-device array arrives as an OPERAND with P(axis)
@@ -2242,17 +2557,33 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         vr = vals_chunk[0].astype(rdt)
         av = jnp.abs(vr)
 
-        def resid_berr(xv):
-            ax = jax.lax.psum(
-                coo_spmv(rc[0], cc[0], vr, xv, n), axis)
-            den = jax.lax.psum(
-                coo_spmv(rc[0], cc[0], av, jnp.abs(xv), n), axis)
-            return _combine_resid(b_r, ax, den)
+        if layout == "ell":
+            def resid_berr(xv):
+                ve = jnp.concatenate([vr, jnp.zeros(1, vr.dtype)])
+                ae = jnp.abs(ve)
+                yl = ell_spmv(cc[0], ve[rc[0]], xv)
+                dl = ell_spmv(cc[0], ae[rc[0]], jnp.abs(xv))
+                di = _flat_axis_index(axis)
+                zfull = jnp.zeros((rchunk * ndev, xv.shape[1]),
+                                  yl.dtype)
+                z0 = jnp.zeros((), di.dtype)
+                ax = jax.lax.psum(jax.lax.dynamic_update_slice(
+                    zfull, yl, (di * rchunk, z0)), axis)[:n]
+                den = jax.lax.psum(jax.lax.dynamic_update_slice(
+                    zfull, dl, (di * rchunk, z0)), axis)[:n]
+                return _combine_resid(b_r, ax, den)
+        else:
+            def resid_berr(xv):
+                ax = jax.lax.psum(
+                    coo_spmv(rc[0], cc[0], vr, xv, n), axis)
+                den = jax.lax.psum(
+                    coo_spmv(rc[0], cc[0], av, jnp.abs(xv), n), axis)
+                return _combine_resid(b_r, ax, den)
 
         return step_body(vals_sel[0] * ssel[0], resid_berr, b_r,
                          _regroup(sched, idx_flat, 7))
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         mapped_body, mesh=mesh,
         in_specs=(P(axis),) * 5 + (P(),) + idx_specs,
         out_specs=(P(), P(), P(), P(), P()),
@@ -2268,10 +2599,14 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         # host work per SamePattern refactorization — the cost of a
         # host-global input API feeding a distributed program.
         v = np.asarray(vals)
-        vchunk = np.pad(v, (0, pad)).reshape(ndev, chunk)
+        if layout == "ell":
+            vchunk = v[vpad_host]
+        else:
+            vchunk = np.pad(v, (0, pad)).reshape(ndev, chunk)
         return jitted(jax.device_put(v[sel], row_shard), scale_sel,
                       jax.device_put(vchunk, row_shard),
-                      rows_c, cols_c, b)
+                      es_c, ec_c, b)
 
     step.sel = sel
+    step.spmv_layout = layout
     return step

@@ -1,0 +1,175 @@
+"""Pallas TPU kernel: tiled extend-add scatter engine.
+
+The reference solves exactly this problem with a device scatter
+kernel (`Scatter`/`dScatter`, SRC/dsuperlu_gpu.cu:115-143): child
+Schur-update blocks land in parent fronts through an index map, and
+letting the generic runtime serialize those indexed writes is the
+difference between HBM-rate and broken throughput.  The round-5
+profile measured XLA's element scatter fusions at 50–200 MB/s on v5e
+(TPU_PROFILE_r05.json) — the TPU has no native scatter datapath, so
+the fusion loops lane-by-lane.
+
+This kernel re-expresses the scatter as MXU work, the datapath the
+chip actually has: for one child update block U (rc_b × tc_b) with
+destination positions pr/pc, the scatter IS the one-hot expansion
+
+    delta_front += S_rᵀ · U · S_c,     S_r[k, p] = (p == pr[k])
+
+two dense matmuls per child, accumulated into the child's parent
+front tile held in VMEM across consecutive children (the schedule
+builder emits records front-sorted, so each front tile is resident
+exactly once).  Sentinel positions (mb / ncols, the padding drop
+convention) one-hot to all-zero rows and vanish — the mode="drop"
+arithmetic for free.  The kernel emits a DELTA array (zeros where no
+child lands, thanks to the donated-zeros aliasing) which the caller
+adds to the assembled front batch.
+
+Gating: `SLU_TPU_PALLAS_SCATTER=1` only (default OFF — this is the
+A/B arm the fire plan prices on hardware; interpret mode runs the
+same kernel on CPU for the correctness oracle in
+tests/test_ea_blocks.py).  f32/bf16 only: f64 has no Mosaic lowering
+(pallas_lu precedent) and complex never reaches here (pair mode
+splits planes before the extend-add).
+
+Precision note: the one-hot factors are exactly representable, but
+the value operand crosses the MXU, so products carry f32-matmul
+(HIGHEST, multi-pass) rounding instead of being exact adds —
+identical error class to every other f32 matmul in the factor, and
+the f64 refinement loop owns the residual either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+try:
+    # same x64-off tracing shim as ops/pallas_lu (Mosaic has no 64-bit
+    # lowering; weak Python scalars must trace at 32 bit)
+    from jax._src.config import enable_x64 as _x64_setting
+    _HAVE_X64_CTX = True
+except ImportError:  # pragma: no cover
+    import contextlib
+
+    _HAVE_X64_CTX = False
+
+    def _x64_setting(_v):
+        return contextlib.nullcontext()
+
+
+def enabled(dtype) -> bool:
+    """Use the Pallas scatter engine?  SLU_TPU_PALLAS_SCATTER=1 only —
+    OFF by default until the fire-plan chain arm prices it on real
+    hardware (the pallas_lu lesson: kernels are resolved by
+    measurement, not hope)."""
+    if not _HAVE_PALLAS:
+        return False
+    if not _HAVE_X64_CTX and jax.config.jax_enable_x64:
+        return False
+    dtype = np.dtype(dtype)
+    if dtype.kind == "c" or dtype.itemsize == 8:
+        return False
+    return os.environ.get("SLU_TPU_PALLAS_SCATTER", "0") == "1"
+
+
+# front tile + child block + two one-hot factors, input and output
+# copies — beyond this the XLA element path keeps the bucket
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def usable(mb: int, ncols: int, rc_b: int, tc_b: int, dtype) -> bool:
+    it = np.dtype(dtype).itemsize
+    need = (2 * mb * ncols + rc_b * tc_b
+            + rc_b * mb + tc_b * ncols) * it
+    return need <= _VMEM_BUDGET_BYTES
+
+
+def _scatter_kernel(fb_ref, upd_ref, pr_ref, pc_ref, base_ref,
+                    out_ref, *, mb: int, ncols: int):
+    """One child per grid step: one-hot expand the (rc_b, tc_b) block
+    into its (mb, ncols) front tile.  out block index = fb[i] (scalar
+    prefetch), so consecutive same-front children accumulate in VMEM;
+    the first child of each front ASSIGNS (the VMEM tile is undefined
+    on arrival — out blocks are write-only)."""
+    i = pl.program_id(0)
+    prev = fb_ref[jnp.maximum(i - 1, 0)]
+    first = jnp.logical_or(i == 0, fb_ref[i] != prev)
+    upd = upd_ref[0]                              # (rc_b, tc_b)
+    pr = pr_ref[0]                                # (rc_b,)
+    pc = pc_ref[0]                                # (tc_b,)
+    rc_b, tc_b = upd.shape
+    # S_r (rc_b, mb), S_c (tc_b, ncols): sentinel pos == mb/ncols has
+    # no matching iota lane -> all-zero row -> dropped
+    rows = jax.lax.broadcasted_iota(jnp.int32, (rc_b, mb), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tc_b, ncols), 1)
+    S_r = (rows == pr[:, None]).astype(upd.dtype)
+    S_c = (cols == pc[:, None]).astype(upd.dtype)
+    mid = jax.lax.dot_general(
+        upd, S_c, dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)       # (rc_b, ncols)
+    contrib = jax.lax.dot_general(
+        S_r, mid, dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+
+    del base_ref   # aliased zeros: only its unvisited blocks matter
+
+    @pl.when(first)
+    def _():
+        out_ref[0] = contrib
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        out_ref[0] = out_ref[0] + contrib
+
+
+def scatter_add_delta(upd, pr, pc, fb, *, mb: int, ncols: int,
+                      n_pad: int, interpret: bool | None = None):
+    """Extend-add delta of one element bucket: `upd` (K, rc_b, tc_b)
+    gathered child blocks, `pr`/`pc` (K, rc_b)/(K, tc_b) int32
+    destination positions (sentinel mb/ncols drops), `fb` (K,) int32
+    front ids, NON-DECREASING (the schedule builder's front order and
+    its K-padding db convention guarantee this).  Returns an
+    (n_pad, mb, ncols) delta: the caller's `F + delta` replaces the
+    serialized element scatter."""
+    K = upd.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(K,),
+        in_specs=[
+            pl.BlockSpec((1,) + upd.shape[1:], lambda i, fb: (i, 0, 0)),
+            pl.BlockSpec((1, pr.shape[1]), lambda i, fb: (i, 0)),
+            pl.BlockSpec((1, pc.shape[1]), lambda i, fb: (i, 0)),
+            pl.BlockSpec((1, mb, ncols), lambda i, fb: (fb[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, mb, ncols),
+                               lambda i, fb: (fb[i], 0, 0)),
+    )
+    kern = functools.partial(_scatter_kernel, mb=mb, ncols=ncols)
+    with _x64_setting(False):
+        delta = pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_pad, mb, ncols),
+                                           upd.dtype),
+            # donate a zeros array into the output so front tiles no
+            # child visits stay exactly zero (out blocks are only
+            # written at visited indices)
+            input_output_aliases={4: 0},
+            interpret=interpret,
+        )(fb, upd, pr, pc, jnp.zeros((n_pad, mb, ncols), upd.dtype))
+    return delta

@@ -48,6 +48,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..plan.plan import FactorPlan
+from ..utils.compat import shard_map as _shard_map
 from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl, _dec,
                            _enc, _factor_group_impl, _fwd_group_impl,
                            _fwd_group_T_impl, _hi_prec, _real_dtype,
@@ -93,7 +94,8 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis,
         sdt, lead = dtype, ()
         vals = jnp.concatenate([vals.astype(dtype),
                                 jnp.zeros(1, dtype)])
-    upd_buf = jnp.zeros(lead + (dsched.upd_total + 1,), sdt)
+    upd_buf = jnp.zeros(lead + (dsched.upd_total + dsched.upd_pad,),
+                        sdt)
     L_flat = jnp.zeros(lead + (dsched.L_total,), sdt)
     U_flat = jnp.zeros(lead + (dsched.U_total,), sdt)
     Li_flat = jnp.zeros(lead + (dsched.Li_total,), sdt)
@@ -109,7 +111,7 @@ def _factor_loop(dsched, vals, thresh_np, dtype, per_group, axis,
             jnp.int32(g.upd_off_global), jnp.int32(g.L_off),
             jnp.int32(g.U_off), jnp.int32(g.Li_off),
             jnp.int32(g.Ui_off), mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-            ea_meta=g.ea_meta,
+            ea_meta=g.ea_meta, eb_meta=g.eb_meta,
             axis=axis, gather=g.needs_gather, coop=g.coop,
             ndev=dsched.ndev, pos_idx=pos_idx, cp=g.cp, tp=g.tp,
             pair=pair)
@@ -306,7 +308,7 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         return _solve_loop(dsched, flats, b, dtype, solve_idx, axis,
                            trans=False)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh, in_specs=(vspec, P()) + idx_specs,
         out_specs=P(), check_vma=False)
 
@@ -377,7 +379,7 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         return (L, U, Li, Ui, jax.lax.psum(tiny, axis),
                 jax.lax.psum(nzero, axis))
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh, in_specs=(vspec,) + idx_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
@@ -420,7 +422,7 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         return _solve_loop(dsched, (L_flat, U_flat, Li_flat, Ui_flat),
                            b, dtype, per_group, axis, trans=trans)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P()) + idx_specs,
         out_specs=P(), check_vma=False)
@@ -513,7 +515,7 @@ def make_dist_solve_rhs_sharded(plan: FactorPlan, mesh: Mesh,
                        n_pad=ndev * g.n_loc, cplx=cplx)
         return _dec(X, cplx)[:n]
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         _hi_prec(body), mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(None, axis)),
         out_specs=P(None, axis), check_vma=False)

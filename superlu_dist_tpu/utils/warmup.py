@@ -56,8 +56,8 @@ def staged_signatures(sched):
         ea_avals = tuple(jax.tree_util.tree_leaves(
             jax.tree_util.tree_map(
                 aval, ea_blocks, is_leaf=lambda x: hasattr(x, "dtype"))))
-        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, aval(a_src),
-                aval(a_dst), aval(one_dst), ea_avals)
+        fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, g.eb_meta,
+                aval(a_src), aval(a_dst), aval(one_dst), ea_avals)
         fsigs.setdefault(fkey, g)
         skey = (g.mb, g.wb, g.n_loc, aval(ci), aval(si))
         ssigs.setdefault(skey, g)
@@ -112,16 +112,18 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
         return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
 
     def compile_factor(item):
-        (mb, wb, n_pad, ea_meta, *_), g = item
+        (mb, wb, n_pad, ea_meta, eb_meta, *_), g = item
         a_src, a_dst, one_dst, ea_blocks = g.dev(squeeze=True)[:4]
         B._staged_factor_group.lower(
-            jax.ShapeDtypeStruct((sched.upd_total + 1,), dtype),
+            jax.ShapeDtypeStruct((sched.upd_total + sched.upd_pad,),
+                                 dtype),
             jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
             jax.ShapeDtypeStruct((), rdt),
             sds(a_src), sds(a_dst), sds(one_dst),
             jax.tree_util.tree_map(sds, ea_blocks),
             jax.ShapeDtypeStruct((), np.int64),
-            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta).compile()
+            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
+            eb_meta=eb_meta).compile()
 
     # X carries promote(factor, rhs) and is real-encoded for complex
     # systems (real/imag halves along the rhs axis — ops/batched._enc)

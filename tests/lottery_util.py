@@ -28,7 +28,8 @@ import numpy as np
 import scipy.sparse as sp
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from superlu_dist_tpu.utils.compat import set_cpu_devices
+set_cpu_devices(8)
 import jax.numpy as jnp
 """
 

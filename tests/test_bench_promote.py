@@ -99,3 +99,38 @@ def test_emit_record_mode_never_promotes(tmp_path):
     rec = next(ln for ln in lines if ln.get("record"))
     assert rec["cpu_fallback"] is True
     assert json.loads(hw_path.read_text()) == HW_REC
+
+
+def test_mfu_plausibility_gate_units(tmp_path, monkeypatch):
+    """The plausibility gate (advisor: a GFLOP/s implying MFU > 100%
+    of bf16 peak is a broken measurement): the predicate itself, and
+    the promotion loader refusing measurement_invalid records."""
+    sys.path.insert(0, ROOT)
+    import bench
+    # predicate: v5e peak 197 TFLOP/s -> 197000 GFLOP/s boundary
+    assert not bench._mfu_invalid(40.0, 197.0)
+    assert not bench._mfu_invalid(196_999.0, 197.0)
+    assert bench._mfu_invalid(325_988.7, 197.0)     # the unroll=32 line
+    assert not bench._mfu_invalid(1e9, 0.0)         # CPU: no peak, no gate
+    # loader: an invalid record must never be promoted as the primary
+    path = tmp_path / "hw.json"
+    monkeypatch.setenv("SLU_BENCH_HW_RECORD", str(path))
+    rec = dict(HW_REC, measurement_invalid=True)
+    assert bench._save_hw_record(rec) is True
+    assert bench._load_hw_record(HW_REC["desc"]) is None
+    # the retroactive voiding of the round-5 chain telemetry stuck
+    chain = os.path.join(ROOT, "TPU_AB_CHAIN.jsonl")
+    lines = [json.loads(ln) for ln in open(chain)]
+    arms = {}
+    cur = None
+    for ln in lines:
+        if "arm" in ln and len(ln) == 1:
+            cur = ln["arm"]
+        elif cur is not None:
+            arms.setdefault(cur, []).append(ln)
+    assert all(r.get("measurement_invalid")
+               for r in arms["SLU_DIAG_UNROLL=32"])
+    assert all(r.get("value", 1) == 0.0
+               for r in arms["SLU_DIAG_UNROLL=32"] if "metric" in r)
+    assert not any(r.get("measurement_invalid")
+                   for r in arms["SLU_LEVEL_MERGE=1"])

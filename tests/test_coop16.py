@@ -26,7 +26,8 @@ import scipy.sparse as sp
 
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 16)
+from superlu_dist_tpu.utils.compat import set_cpu_devices
+set_cpu_devices(16)
 
 from superlu_dist_tpu.utils.cache import host_cache_dir
 import os

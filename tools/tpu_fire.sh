@@ -51,7 +51,8 @@ SLU_BENCH_ASSUME_LIVE=1 timeout 1500 python "$repo/bench.py" \
 rc=$?
 cat "$bench_tmp" >> "$log"
 if grep -q '"cpu_fallback": false' "$bench_tmp" \
-   && ! grep -q '"promoted": true' "$bench_tmp"; then
+   && ! grep -q '"promoted": true' "$bench_tmp" \
+   && ! grep -q '"measurement_invalid": true' "$bench_tmp"; then
   # a genuine on-hardware line: bench stamps the contract line itself
   # (ts/desc/commit) and self-writes it to the record file, reporting
   # the save outcome in-band (`hw_record_saved`).  The mv remains for
@@ -80,12 +81,20 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   stamp "profile rc=$?"
 fi
 
+# 2b. The round-6 headline must be measured AT THE ROUND'S HEAD
+#     (VERDICT r5 "Next round" #2: no more stale promoted records as
+#     the only headline).  Step 1 just did exactly that — the primary
+#     bench runs FIRST in every window, so the scatter-free hot path
+#     (ELL residual + block-copy extend-add, the defaults since this
+#     round) is what it measured; the profile above certifies the
+#     per-fusion-class budget (scatter_gather_ms) for the same tree.
+
 # 3. Hardware smoke — the complex-path cleanliness measurement that
 #    decides the real-view codec gate (TPU_SMOKE.jsonl), the pair
 #    lowering certification (c128_pair_*), Pallas compile.  240 s per
 #    check: generous for the measured ~92 s compile class, and a
 #    repeat of the known c128 wedge costs 4 min of the window, not
-#    the full default budget.  Outer 2100 s covers probe (120) + 6
+#    the full default budget.  Outer 2100 s covers probe (120) + 7
 #    checks x 240 + teardown slack.
 SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
   timeout 2100 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
@@ -124,19 +133,29 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #    their desc; these env knobs don't reach the desc string —
   #    except SLU_BENCH_FACTOR_DTYPE and SLU_STAGED, which bench.py
   #    self-annotates as ' fdt=…' / ' staged').
-  for arm in "SLU_LEVEL_MERGE=1" \
-             "SLU_DIAG_UNROLL=32" \
-             "SLU_LEVEL_MERGE=1 SLU_DIAG_UNROLL=32" \
+  #    Round-6 arms lead with the scatter-free hot path's A/B pair
+  #    (the defaults are ELL + block-copy; the arms price the OLD
+  #    formulations so the win is measured, not assumed) and the new
+  #    Pallas scatter engine; the surviving round-5 levers follow.
+  #    An arm whose measured GFLOP/s implies >100% of bf16 peak is
+  #    stamped measurement_invalid by bench.py and DISCARDED here,
+  #    exactly like a cpu_fallback arm (the unroll=32 lesson).
+  for arm in "SLU_SPMV_LAYOUT=coo" \
+             "SLU_EA_BLOCK=0" \
+             "SLU_SPMV_LAYOUT=coo SLU_EA_BLOCK=0" \
+             "SLU_TPU_PALLAS_SCATTER=1" \
+             "SLU_TPU_PALLAS_SCATTER=1 SLU_EA_BLOCK=0" \
+             "SLU_EA_BLOCK_MIN_RUN=2" \
+             "SLU_LEVEL_MERGE=1" \
              "SLU_LEVEL_MERGE=1 SLU_LEVEL_MERGE_LIMIT=4" \
-             "SLU_DIAG_UNROLL=16" \
              "SLU_TPU_PALLAS=1" \
-             "SLU_TPU_PALLAS=1 SLU_LEVEL_MERGE=1" \
              "SLU_BENCH_FACTOR_DTYPE=bfloat16"; do
     ab_tmp=$(mktemp)
     env $arm SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_EMIT_RECORD=1 \
       timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
     rc=$?
-    if grep -q '"cpu_fallback": false' "$ab_tmp"; then
+    if grep -q '"cpu_fallback": false' "$ab_tmp" \
+       && ! grep -q '"measurement_invalid": true' "$ab_tmp"; then
       { printf '{"arm": "%s"}\n' "$arm"; cat "$ab_tmp"; } \
         >> "$repo/TPU_AB_CHAIN.jsonl"
       stamp "chain arm [$arm] rc=$rc (recorded)"
@@ -165,7 +184,7 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   #    config just compiled/ran it, so the profile is warm; the
   #    scale regime's op mix differs from n=27k and is where the
   #    wall/flop question actually lives
-  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r05_k48.json" \
+  SLU_PROFILE_K=48 SLU_PROFILE_OUT="$repo/TPU_PROFILE_r06_k48.json" \
     timeout 900 python "$repo/tools/tpu_profile.py" >> "$log" 2>&1
   stamp "profile k48 rc=$?"
   # 8. Pallas on-chip A/B (kernel-level; cheapest to lose).
@@ -187,7 +206,8 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     SUPERLU_AMALG_TAU_PCT=$tau SUPERLU_AMALG_CAP=$cap \
       timeout 1200 python "$repo/bench.py" > "$ab_tmp" 2>> "$log"
     rc=$?
-    if grep -q '"cpu_fallback": false' "$ab_tmp"; then
+    if grep -q '"cpu_fallback": false' "$ab_tmp" \
+       && ! grep -q '"measurement_invalid": true' "$ab_tmp"; then
       cat "$ab_tmp" >> "$repo/TPU_AB_TAU.jsonl"
       stamp "amalg tau=$tau cap=$cap rc=$rc (recorded)"
     else

@@ -61,7 +61,8 @@ import time
 # it runs after them — a short window answers the new question
 # before re-documenting the old one.
 CHECKS = ("f32_ir_solve", "c128_pair_kernel", "c128_pair_solve",
-          "c128_solve", "pallas_compile", "c128_kernel")
+          "c128_solve", "pallas_compile", "pallas_scatter_compile",
+          "c128_kernel")
 
 
 def _build_matrix():
@@ -185,6 +186,29 @@ def run_check(name):
         Fp, tp, zp = partial_lu_batch_pallas(
             jnp.asarray(F), np.float32(1e-30), wb=32, interpret=False)
         return dict(tiny=int(tp))
+
+    if name == "pallas_scatter_compile":
+        # the scatter-engine certification (ISSUE 2b): Mosaic-compile
+        # the one-hot extend-add kernel on the real chip and check it
+        # against the element-scatter oracle — green here arms the
+        # SLU_TPU_PALLAS_SCATTER fire-plan A/B arm
+        from superlu_dist_tpu.ops.pallas_scatter import scatter_add_delta
+        rng = np.random.default_rng(3)
+        K, rc_b, mb = 6, 8, 128
+        upd = rng.standard_normal((K, rc_b, rc_b)).astype(np.float32)
+        pr = np.sort(rng.integers(0, mb, (K, rc_b))).astype(np.int32)
+        fb = np.sort(rng.integers(0, 3, K)).astype(np.int32)
+        delta = np.asarray(scatter_add_delta(
+            jnp.asarray(upd), jnp.asarray(pr), jnp.asarray(pr),
+            jnp.asarray(fb), mb=mb, ncols=mb, n_pad=4,
+            interpret=False))
+        ref = np.zeros((4, mb, mb), np.float32)
+        for k in range(K):
+            for i in range(rc_b):
+                for j in range(rc_b):
+                    ref[fb[k], pr[k, i], pr[k, j]] += upd[k, i, j]
+        err = float(np.abs(delta - ref).max())
+        return dict(max_err=err, exact_class=bool(err < 1e-4))
 
     raise ValueError(f"unknown check {name!r}")
 
