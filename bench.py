@@ -468,6 +468,16 @@ def _run_config(a, desc, nrhs, jnp):
 
 
 def main():
+    if "--serve" in sys.argv[1:]:
+        # serve-mode load benchmark (tools/serve_bench.py): factor
+        # once, drive concurrent solves through the micro-batching
+        # service, append the record to SERVE_LATENCY.jsonl
+        import runpy
+        runpy.run_path(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "serve_bench.py"),
+            run_name="__main__")
+        return
     if os.environ.get("SLU_BENCH_PRIME_SCIPY") == "1":
         # baseline priming touches no device — safe anytime, cheap
         # no-op once every ladder config is cached

@@ -67,6 +67,7 @@ from .models.gssvx import (  # noqa: E402
     gssvx,
     query_space,
     solve,
+    warm_solve,
 )
 from .parallel.grid import make_solver_mesh  # noqa: E402
 from .parallel.multihost import (  # noqa: E402
@@ -107,5 +108,6 @@ __all__ = [
     "query_space",
     "read_matrix",
     "solve",
+    "warm_solve",
     "__version__",
 ]

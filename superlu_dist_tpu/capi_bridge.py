@@ -127,8 +127,8 @@ def solve_factored(handle, nrhs, b_addr, x_addr, trans) -> int:
     n = lu.plan.n
     b = _b_colmajor(b_addr, n, nrhs)
     x = _solve(lu_t, b)
-    # keep any refinement-operand cache the solve built on the copy
-    lu.refine_cache = lu_t.refine_cache
+    # the replace copy shares the handle's refine_cache container, so
+    # operands built during this solve persist on the handle
     _write_colmajor(x_addr, x if x.ndim == 2 else x[:, None])
     return 0
 

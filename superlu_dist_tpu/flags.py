@@ -1,0 +1,99 @@
+"""Registry of every `SLU_`-prefixed environment flag.
+
+The package and its tools grew ~50 `SLU_*` env knobs; this table is
+the single place they are all named and described.  tests/test_flags.py
+greps the package, tools/ and bench.py for `SLU_[A-Z_0-9]+` tokens and
+fails when a read is undocumented here (or when an entry here no
+longer corresponds to any read) — so the table cannot rot.
+
+Convention: boolean flags take "1"/"0"; numeric flags parse int/float;
+unset means the documented default.  SUPERLU_*-prefixed knobs are the
+reference's sp_ienv analog chain and live on Options fields
+(options.py), not here.
+"""
+
+from __future__ import annotations
+
+# flag name -> one-line description (scope: where it is read)
+FLAGS: dict[str, str] = {
+    # --- execution-mode selection (ops/batched.py) ---
+    "SLU_STAGED": "1/0 force per-group staged execution on/off (default: auto past SLU_STAGED_MIN_GROUPS groups)",
+    "SLU_STAGED_MIN_GROUPS": "group count past which staged execution turns on automatically (default 96)",
+    "SLU_LEVEL_MERGE": "1 = coalesce each etree level's bucket groups into one padded group",
+    "SLU_LEVEL_MERGE_LIMIT": "max padded-flop growth factor a level merge may incur (default 1.5)",
+    "SLU_DIAG_UNROLL": "diagonal-panel elimination unroll factor, parsed once at import",
+    # --- extend-add lanes (ops/batched.py) ---
+    "SLU_EA_BLOCK": "1/0 block-copy extend-add lane for contiguous child runs (default on)",
+    "SLU_EA_BLOCK_MIN_RUN": "minimum contiguous run length routed to the block lane (default 8)",
+    # --- residual SpMV layout (ops/spmv.py) ---
+    "SLU_SPMV_LAYOUT": "auto|ell|coo residual SpMV layout (ell = scatter-free padded rows)",
+    "SLU_SPMV_ELL_WASTE": "max ELL padding ratio over true nnz before falling back to COO (default 4)",
+    # --- complex storage / platform gates (ops, utils/platform.py) ---
+    "SLU_COMPLEX_PAIR": "1 = store complex factors as stacked real/imag planes (TPU lowering workaround)",
+    "SLU_COMPLEX_TPU": "1 = re-enable on-accelerator complex despite the known mesh lowering hang",
+    "SLU_MATMUL_PREC": "default|high|highest jax matmul precision pin applied at import (__init__.py)",
+    # --- cooperative mesh factorization (ops/coop_lu.py, coop_sharded.py) ---
+    "SLU_COOP_SHARDED": "1/0 sharded cooperative mesh path vs legacy replicated coop",
+    "SLU_COOP_B": "round-robin block size for group-to-device ownership (default 1)",
+    "SLU_COOP_MB": "front-size cap for cooperative factorization tiles (default 256)",
+    "SLU_COOP_SOLVE_ROTATE": "1 = rotate solve ownership across devices instead of device 0",
+    "SLU_RHS_SHARDED": "auto|1|0 shard wide RHS blocks over the mesh for the dist solve",
+    # --- Pallas kernels (ops/pallas_lu.py, pallas_scatter.py) ---
+    "SLU_TPU_PALLAS": "1 = enable the Pallas diagonal-LU kernel (validated, retired to opt-in)",
+    "SLU_TPU_PALLAS_COLUMN": "1 = force the per-column rank-1 Pallas LU variant",
+    "SLU_TPU_PALLAS_SCATTER": "1 = enable the Pallas one-hot MXU scatter engine for ragged extend-add",
+    # --- planning / ordering (parallel/ordering_dist.py) ---
+    "SLU_DORDER_CLUSTER": "distributed-ordering aggregation block size (default 16)",
+    # --- native library (utils/native.py) ---
+    "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
+    # --- accelerator amalgamation defaults (utils/platform.py) ---
+    "SLU_ACCEL_AMALG_APPLIED": "internal: records which amalg env defaults were applied (re-exec handshake)",
+    # --- bench.py driver ---
+    "SLU_BENCH_K": "bench grid size k (Laplacian family)",
+    "SLU_BENCH_NRHS": "bench right-hand-side count",
+    "SLU_BENCH_SHAPE": "bench matrix family selector (2d|3d|...)",
+    "SLU_BENCH_FACTOR_DTYPE": "bench factorization dtype override",
+    "SLU_BENCH_EMIT_RECORD": "1 = emit the BENCH json record even for rehearsal runs",
+    "SLU_BENCH_HW_RECORD": "path override for the hardware bench record",
+    "SLU_BENCH_HW_MAX_AGE_DAYS": "max age before a hardware record is treated as stale",
+    "SLU_BENCH_ASSUME_LIVE": "1 = skip the accelerator liveness probe",
+    "SLU_BENCH_PROBE_TIMEOUT": "accelerator liveness probe timeout (s)",
+    "SLU_BENCH_PROBE_RETRIES": "accelerator liveness probe retry count",
+    "SLU_BENCH_FORCE_FALLBACK": "1 = pretend the accelerator probe failed (test the CPU fallback)",
+    "SLU_BENCH_CHILD": "internal: set on the re-exec'd CPU-fallback bench child",
+    "SLU_BENCH_FAIL_REASON": "internal: carries the accelerator failure reason into the child",
+    "SLU_BENCH_PRIME_SCIPY": "1 = only (re)compute the scipy baseline cache and exit",
+    "SLU_BENCH_STAGED_MIN_K": "bench k at which staged execution is allowed on",
+    "SLU_BENCH_SWEEP": "1 = run the multi-config bench sweep",
+    "SLU_BENCH_SWEEP_KS": "comma list of k values for the sweep",
+    "SLU_BENCH_SWEEP_PATH": "output path for sweep records (default BENCH_SWEEP.jsonl)",
+    "SLU_SWEEP_CONFIG_TIMEOUT": "per-config subprocess budget in the sweep (s)",
+    # --- tools/ drivers ---
+    "SLU_SCALE_K": "tools/scale_run.py grid size (k=64 is the 262k certification)",
+    "SLU_SCALE_OUT": "tools/scale_run.py output json path",
+    "SLU_SOLVE_K": "tools/solve_latency.py grid size (default 30)",
+    "SLU_PROFILE_K": "tools/tpu_profile.py grid size",
+    "SLU_PROFILE_OUT": "tools/tpu_profile.py output json path",
+    "SLU_PROFILE_DRYRUN": "1 = tpu_profile rehearsal on CPU (no tunnel required)",
+    "SLU_SMOKE_CHECK_TIMEOUT": "tools/tpu_smoke.py per-check budget (s)",
+    "SLU_AB_CHAIN": "tools/pallas_ab.py in-jit repetitions per dispatch (default 8)",
+    "SLU_AB_CONFIGS": "tools/pallas_ab.py 'wb,mb,N;...' config override (interpret smoke)",
+    # --- serve layer (tools/serve_bench.py) ---
+    "SLU_SERVE_K": "serve_bench grid size k (3D Laplacian, n=k^3; default 8)",
+    "SLU_SERVE_CONCURRENCY": "serve_bench closed-loop worker count (default 16)",
+    "SLU_SERVE_REQUESTS": "serve_bench total request count (default 192)",
+    "SLU_SERVE_LINGER_MS": "serve_bench micro-batcher max linger (ms, default 2)",
+    "SLU_SERVE_OUT": "serve_bench output path (default SERVE_LATENCY.jsonl)",
+    "SLU_SERVE_MIN_SPEEDUP": "serve_bench regression floor on batched-vs-sequential speedup (default 1.0 = never lose; timeshared-box noise)",
+}
+
+# Tokens the registry test's grep will hit that are NOT env flags:
+# enum member names and docstring mentions of reference storage
+# formats / flag-family prefixes.
+NON_FLAG_TOKENS: frozenset = frozenset({
+    "SLU_SINGLE",    # IterRefine enum member (options.py)
+    "SLU_DOUBLE",    # IterRefine enum member (options.py)
+    "SLU_NC",        # reference SuperMatrix storage format name
+    "SLU_COOP_",     # prefix shorthand in a batched.py comment
+    "SLU_",          # the bare prefix itself (docstrings)
+})

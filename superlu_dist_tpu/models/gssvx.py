@@ -18,6 +18,7 @@ and "host" (numpy reference multifrontal).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional
 
 import numpy as np
@@ -41,8 +42,19 @@ class LUFactorization:
     stats: Optional[Stats] = None
     options: Optional[Options] = None     # effective numeric options
     # cached refinement operands (rebuilt per factorization, reused
-    # across the many solves the FACTORED rung is for)
-    refine_cache: Optional[dict] = None
+    # across the many solves the FACTORED rung is for).  A shared
+    # MUTABLE container, populated in place (models/refine.py
+    # _operands): dataclasses.replace copies — the FACTORED/CONJ
+    # rungs and the serve layer's per-request option merges — all see
+    # one build, instead of each copy rebuilding its own O(nnz)
+    # operands
+    refine_cache: dict = dataclasses.field(default_factory=dict,
+                                           repr=False, compare=False)
+    # guards the lazy operand-cache build above; replace copies carry
+    # the SAME lock object, so handle copies serialize against each
+    # other
+    cache_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
     @property
     def n(self) -> int:
@@ -195,11 +207,10 @@ def solve(lu: LUFactorization, b: np.ndarray,
         # (Aᴴ)⁻¹·b = conj((Aᵀ)⁻¹·conj(b)) — run the TRANS pipeline
         # (refinement included) on the conjugated system
         merged = options.replace(trans=Trans.TRANS)
+        # the replace copy shares refine_cache, so operands the inner
+        # solve builds are kept for the FACTORED rung automatically
         lu_t = dataclasses.replace(lu, options=merged)
         x = solve(lu_t, np.conj(bb), stats=stats)
-        # keep the refinement operand cache the inner solve built (the
-        # handle copy is throwaway; the cache is what FACTORED reuses)
-        lu.refine_cache = lu_t.refine_cache
         x = np.conj(x)
         return x[:, 0] if squeeze else x
 
@@ -248,6 +259,29 @@ def solve(lu: LUFactorization, b: np.ndarray,
             stats.refine_steps += steps
 
     return x[:, 0] if squeeze else x
+
+
+def solve_rhs_dtype(lu: LUFactorization) -> np.dtype:
+    """The dtype a plain float64 RHS produces after the solve path's
+    promote_types against the factors — the ONE definition of the
+    compiled solve program's operand dtype, shared by warm_solve and
+    the serve micro-batcher (warming a different dtype compiles the
+    wrong program)."""
+    return np.promote_types(
+        np.dtype(lu.effective_options.factor_dtype), np.float64)
+
+
+def warm_solve(lu: LUFactorization, nrhs_widths=(1,),
+               dtype=None) -> None:
+    """Pre-compile the jitted solve programs for the given RHS widths
+    with zero solves (a zero RHS is exact under the sweeps, and a
+    (n, k) zero block traces the identical program live traffic
+    uses).  Standalone users' analog of the serve micro-batcher's
+    warmup (serve/batcher.py), which applies the same
+    solve_rhs_dtype rule through its per-variant solve_fn."""
+    dt = np.dtype(dtype) if dtype is not None else solve_rhs_dtype(lu)
+    for k in nrhs_widths:
+        solve(lu, np.zeros((lu.n, int(k)), dtype=dt))
 
 
 def get_diag_u(lu: LUFactorization) -> np.ndarray:
@@ -357,12 +391,13 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
     if options.fact == Fact.FACTORED:
         # honor the caller's SOLVE-time knobs on the reused handle;
         # factorization-describing knobs (factor_dtype, equil,
-        # col_perm, ...) must keep describing the stored factors
-        merged = lu.effective_options.replace(
-            trans=options.trans, iter_refine=options.iter_refine,
-            refine_dtype=options.refine_dtype,
-            max_refine_steps=options.max_refine_steps)
-        lu = dataclasses.replace(lu, options=merged)
+        # col_perm, ...) must keep describing the stored factors.
+        # The replace copy shares the caller handle's refine_cache
+        # container, so operands built here serve later reuses too.
+        from ..options import merge_solve_options
+        lu = dataclasses.replace(
+            lu, options=merge_solve_options(lu.effective_options,
+                                            options))
     elif (lu is not None and options.fact == Fact.SAME_PATTERN):
         # reuse only the fill-reducing column permutation (the
         # expensive ordering); recompute equilibration, row perm and

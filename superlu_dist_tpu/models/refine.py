@@ -50,12 +50,24 @@ def _operands(lu, sys_dtype):
     if (not np.issubdtype(lu.a.dtype, np.complexfloating)
             and np.issubdtype(rdt, np.complexfloating)):
         adt = np.dtype(np.dtype(rdt).char.lower())  # c->f of same width
-    cache = lu.refine_cache
-    if cache is None or cache.get("dtype") != adt:
-        asp = lu.a.to_scipy().astype(adt)
-        lu.refine_cache = cache = {
-            "dtype": adt, "asp": asp, "abs_a": abs(asp)}
-    return cache["asp"], cache["abs_a"]
+    # the cache is a SHARED container mutated in place (never
+    # reassigned): dataclasses.replace handle copies — the
+    # FACTORED/CONJ rungs, the serve layer's per-request option
+    # merges — all see one build.  One entry PER operand dtype
+    # (bounded by the handful of refine precisions), inserted fully
+    # formed under the handle lock, so a lock-free fast-path reader
+    # never sees a torn (asp, abs_a) pair and alternating-dtype
+    # callers sharing one handle never thrash rebuilds
+    cache = lu.refine_cache   # dataclass default_factory guarantees
+    ent = cache.get(adt)      # the container exists on every handle
+    if ent is None:
+        with lu.cache_lock:
+            ent = cache.get(adt)
+            if ent is None:
+                asp = lu.a.to_scipy().astype(adt)
+                ent = {"asp": asp, "abs_a": abs(asp)}
+                cache[adt] = ent    # atomic insert of a complete entry
+    return ent["asp"], ent["abs_a"]
 
 
 def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,

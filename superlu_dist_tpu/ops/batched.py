@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import List, Optional, Tuple
 
 import jax
@@ -1864,6 +1865,12 @@ def _lu_is_pair(lu) -> bool:
     return lu.L_flat.ndim == 2
 
 
+# serializes whole-phase jit-wrapper construction across threads (the
+# wrappers are cheap; the point is ONE wrapper object per key so the
+# underlying jit cache dedupes compiles)
+_phase_fns_lock = threading.Lock()
+
+
 def _phase_fns(sched, dtype, thresh_np, pair=None):
     """Cached whole-phase jitted programs for a (schedule, dtype):
     factor, solve and transpose-solve each compile ONCE and run as a
@@ -1874,32 +1881,46 @@ def _phase_fns(sched, dtype, thresh_np, pair=None):
     `pair` selects plane storage (default: the env-resolved
     _pair_mode).  Solve-time callers pass the HANDLE's actual storage
     (_lu_is_pair) so a factorization held across an env change still
-    gets a program matching its flats."""
-    cache = getattr(sched, "_phase_fns", None)
-    if cache is None:
-        cache = sched._phase_fns = {}
+    gets a program matching its flats.
+
+    Guarded by a module lock: the serve layer's first concurrent
+    solves on a fresh schedule would otherwise each build their OWN
+    jit wrapper (last-wins dict write) and trace/compile the same
+    program once per racing thread."""
     if pair is None:
         pair = _pair_mode(dtype)
     key = (np.dtype(dtype).str, float(thresh_np), pair)
-    if key in cache:
+    # lock-free hit path: entries are inserted fully formed under the
+    # lock, and dict reads are GIL-atomic — hot solve dispatches never
+    # contend on the module lock
+    cache = getattr(sched, "_phase_fns", None)
+    if cache is not None:
+        fns = cache.get(key)
+        if fns is not None:
+            return fns
+    with _phase_fns_lock:
+        cache = getattr(sched, "_phase_fns", None)
+        if cache is None:
+            cache = sched._phase_fns = {}
+        if key in cache:
+            return cache[key]
+        from ..parallel.factor_dist import _factor_loop, _solve_loop
+        per_group = [g.dev(squeeze=True) for g in sched.groups]
+        pairs = [(t[5], t[6]) for t in per_group]
+        dtype = np.dtype(dtype)
+
+        @jax.jit
+        def factor_fn(vals):
+            return _factor_loop(sched, vals, thresh_np, dtype,
+                                per_group, None, pair=pair)
+
+        @functools.partial(jax.jit, static_argnames=("trans",))
+        def solve_fn(L, U, Li, Ui, b, trans=False):
+            return _solve_loop(sched, (L, U, Li, Ui), b, dtype, pairs,
+                               None, trans=trans, pair=pair)
+
+        cache[key] = (factor_fn, solve_fn)
         return cache[key]
-    from ..parallel.factor_dist import _factor_loop, _solve_loop
-    per_group = [g.dev(squeeze=True) for g in sched.groups]
-    pairs = [(t[5], t[6]) for t in per_group]
-    dtype = np.dtype(dtype)
-
-    @jax.jit
-    def factor_fn(vals):
-        return _factor_loop(sched, vals, thresh_np, dtype, per_group,
-                            None, pair=pair)
-
-    @functools.partial(jax.jit, static_argnames=("trans",))
-    def solve_fn(L, U, Li, Ui, b, trans=False):
-        return _solve_loop(sched, (L, U, Li, Ui), b, dtype, pairs,
-                           None, trans=trans, pair=pair)
-
-    cache[key] = (factor_fn, solve_fn)
-    return cache[key]
 
 
 def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,

@@ -75,6 +75,40 @@ class Trans(enum.Enum):
     CONJ = 2
 
 
+# --- factor-cache key contract (serve/factor_cache.py) -------------
+# Fields whose values change what numeric factors are computed; the
+# serve-layer cache key hashes exactly these (via Options.factor_key).
+FACTOR_KEY_FIELDS = (
+    "equil", "row_perm", "col_perm", "replace_tiny_pivot",
+    "relax", "max_super", "amalg_tau", "amalg_cap",
+    "factor_dtype",
+    "width_buckets", "front_buckets", "autotune", "algo3d",
+)
+# NOT in the key: symb_threads/nd_threads (parallelism of the planning
+# pass, bit-identical output — test_multiprocess_dist pins it) and
+# escalate (a gssvx driver policy; factorize() never reads it).
+# Per-request solve knobs: merged onto a reused handle by the
+# FACTORED rung (models/gssvx.py gssvx), never part of the cache key.
+SOLVE_TIME_FIELDS = ("trans", "iter_refine", "refine_dtype",
+                     "max_refine_steps")
+
+
+def merge_solve_options(base: "Options", request: "Options") -> "Options":
+    """`base` (the options describing stored factors) with the
+    request's SOLVE_TIME_FIELDS — the one implementation of the
+    FACTORED-rung merge (gssvx and the serve layer both use it, so a
+    future solve-time knob added to SOLVE_TIME_FIELDS propagates to
+    every merge site)."""
+    return base.replace(**{f: getattr(request, f)
+                           for f in SOLVE_TIME_FIELDS})
+
+
+def solve_options_key(options: "Options") -> tuple:
+    """The request's solve-time knob values as a hashable tuple (the
+    serve layer's batcher-variant key leg)."""
+    return tuple(getattr(options, f) for f in SOLVE_TIME_FIELDS)
+
+
 def _env_int(name: str, default: int) -> int:
     """Env-var override, mirroring sp_ienv_dist's SUPERLU_* chain
     (SRC/sp_ienv.c:60-146)."""
@@ -169,6 +203,25 @@ class Options:
 
     def replace(self, **kw) -> "Options":
         return dataclasses.replace(self, **kw)
+
+    def factor_key(self) -> tuple:
+        """The factorization-describing knob values, as a hashable
+        tuple — the options leg of the serve factor-cache key
+        (serve/factor_cache.py).
+
+        Exactly the fields in FACTOR_KEY_FIELDS participate: knobs
+        that change what factors are COMPUTED (perms, scalings,
+        supernode shaping, precision, distribution).  Solve-time
+        knobs (SOLVE_TIME_FIELDS) are deliberately absent — the
+        FACTORED rung in models/gssvx.py merges them per request, so
+        two callers differing only in trans/refinement must share one
+        cache entry.  `fact` itself is a request mode, not a property
+        of the factors, and is likewise excluded."""
+        out = []
+        for name in FACTOR_KEY_FIELDS:
+            v = getattr(self, name)
+            out.append(v.name if isinstance(v, enum.Enum) else v)
+        return tuple(out)
 
     def describe(self) -> str:
         """print_options_dist analog (SRC/util.c:242): one line per

@@ -1,0 +1,49 @@
+"""serve/ — the solve-service layer.
+
+Turns the batch-shaped solver (factor once, solve once) into a
+multi-tenant service: an LRU factor cache with single-flight
+factorization (factor_cache.py), RHS micro-batching over a fixed
+nrhs bucket ladder so the jitted solver never recompiles after warmup
+(batcher.py), a front door with admission control and per-request
+deadlines (service.py), structured metrics (metrics.py), and a
+seeded closed-loop load generator (loadgen.py).  Driven end to end by
+tools/serve_bench.py, which appends records to SERVE_LATENCY.jsonl.
+
+Quickstart:
+
+    from superlu_dist_tpu.serve import ServeConfig, SolveService
+    svc = SolveService(ServeConfig(max_queue_depth=64))
+    key = svc.prefactor(a, Options(factor_dtype="float32"))
+    x = svc.solve(key, b, deadline_s=0.5)       # batched under load
+"""
+
+from .batcher import BUCKET_LADDER, MicroBatcher, bucket_for
+from .errors import (DeadlineExceeded, FactorMissError, ServeError,
+                     ServeRejected)
+from .factor_cache import (CacheKey, FactorCache, matrix_key,
+                           pattern_fingerprint, values_fingerprint)
+from .loadgen import run_load
+from .metrics import Counter, Histogram, Metrics
+from .service import ServeConfig, SolveService, solve_jit_cache_size
+
+__all__ = [
+    "BUCKET_LADDER",
+    "CacheKey",
+    "Counter",
+    "DeadlineExceeded",
+    "FactorCache",
+    "FactorMissError",
+    "Histogram",
+    "Metrics",
+    "MicroBatcher",
+    "ServeConfig",
+    "ServeError",
+    "ServeRejected",
+    "SolveService",
+    "bucket_for",
+    "matrix_key",
+    "pattern_fingerprint",
+    "run_load",
+    "solve_jit_cache_size",
+    "values_fingerprint",
+]

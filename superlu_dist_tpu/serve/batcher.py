@@ -1,0 +1,227 @@
+"""RHS micro-batching: coalesce concurrent solves into one dispatch.
+
+The triangular-solve path is a chain of O(#groups) small dispatches
+whose cost is nearly flat in nrhs — SOLVE_LATENCY.jsonl: 59 ms at
+nrhs=1 vs 8.3 ms/rhs at nrhs=64, a 7× amortization.  This is the
+inference-server continuous-batching shape applied to RHS vectors:
+concurrent `submit(b)` calls against one factorization are gathered
+into a single `solve(lu, B)` with B's column count padded up a fixed
+bucket ladder, so after one warmup pass per bucket the jitted solver
+never sees a new shape and never recompiles.
+
+Flush policy: a batch is dispatched when the widest bucket fills, or
+when the oldest pending request has lingered `max_linger_s` — the
+classic latency/occupancy knob.  Deadlines are enforced at both ends:
+a request already past its deadline when assembly starts is dropped
+from the batch (its slot is not wasted), and a request whose solve
+lands after its deadline gets DeadlineExceeded instead of the result
+(never a success after the deadline).
+
+Padding columns are zeros; a zero RHS is exact under the triangular
+sweeps and contributes berr=0 to refinement, so padded work never
+perturbs the convergence loop of real columns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..models.gssvx import LUFactorization, solve, solve_rhs_dtype
+from .errors import DeadlineExceeded, ServeError
+from .metrics import Metrics
+
+# nrhs bucket ladder: the only column counts the jitted solver ever
+# sees.  Small enough that warmup is 5 compiles; log-spaced so padding
+# waste is bounded by ~2x (amortization already beats that at 8).
+BUCKET_LADDER = (1, 8, 16, 32, 64)
+
+# flush this far ahead of the earliest pending deadline so the solve
+# has a chance to land inside it
+_DEADLINE_FLUSH_MARGIN_S = 0.001
+
+
+def bucket_for(nrhs: int, ladder=BUCKET_LADDER) -> int:
+    """Smallest ladder bucket ≥ nrhs (callers cap nrhs at ladder[-1])."""
+    for b in ladder:
+        if nrhs <= b:
+            return b
+    return ladder[-1]
+
+
+class _Request:
+    __slots__ = ("b", "deadline", "future", "t_submit")
+
+    def __init__(self, b, deadline):
+        self.b = b
+        self.deadline = deadline          # absolute monotonic time or None
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class MicroBatcher:
+    """Per-factorization batching queue with a background flusher.
+
+    One MicroBatcher serves one LUFactorization handle (the service
+    keeps one per hot cache key).  `solve_fn(lu, B) -> X` is
+    injectable for tests; the default is the full models/gssvx.py
+    solve (refinement included, per the handle's options).
+    """
+
+    def __init__(self, lu: LUFactorization,
+                 max_linger_s: float = 0.002,
+                 ladder=BUCKET_LADDER,
+                 metrics: Metrics | None = None,
+                 solve_fn=None,
+                 dtype=None) -> None:
+        self.lu = lu
+        self.max_linger_s = max_linger_s
+        self.ladder = tuple(sorted(ladder))
+        self.metrics = metrics or Metrics()
+        self._solve_fn = solve_fn or solve
+        # the ONE dtype every batch is assembled in — program identity
+        # must not depend on batch composition.  Default: the shared
+        # gssvx.solve_rhs_dtype rule (complex factors promote to
+        # c128).  submit() rejects an RHS that would promote past it.
+        self.dtype = (np.dtype(dtype) if dtype is not None
+                      else solve_rhs_dtype(lu))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list[_Request] = []
+        self._closed = False
+        self.batches_dispatched = 0
+        self._flusher = threading.Thread(target=self._run,
+                                         name="slu-serve-flusher",
+                                         daemon=True)
+        self._flusher.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, b: np.ndarray, deadline: float | None = None) -> Future:
+        """Enqueue one RHS vector (n,); resolves to x (n,).  `deadline`
+        is absolute `time.monotonic()` time."""
+        b = np.asarray(b)
+        if b.ndim != 1 or b.shape[0] != self.lu.n:
+            raise ValueError(
+                f"rhs must be ({self.lu.n},); got {b.shape}")
+        if np.promote_types(b.dtype, self.dtype) != self.dtype:
+            raise ValueError(
+                f"rhs dtype {b.dtype} would promote the batch past "
+                f"{self.dtype} and change the compiled program; "
+                "prefactor the matrix with a matching factor_dtype "
+                "(or solve it unbatched)")
+        req = _Request(b, deadline)
+        with self._cond:
+            if self._closed:
+                # ServeError so the service can map a retired batcher
+                # (concurrent eviction) to its cold-key contract
+                raise ServeError("batcher is closed")
+            self._pending.append(req)
+            self._cond.notify()
+        return req.future
+
+    def warmup(self, dtype=None) -> None:
+        """Compile every ladder bucket with a zero solve so live
+        traffic never triggers a jit recompile: the padded shapes in
+        self.dtype are the ONLY (shape, dtype) signatures this
+        batcher's dispatches ever produce."""
+        dt = np.dtype(dtype) if dtype is not None else self.dtype
+        # a solve_fn may expose a metrics-free twin for warmup (the
+        # service's merged variant does: synthetic zero solves must
+        # not pollute the berr/latency histograms)
+        fn = getattr(self._solve_fn, "warmup_fn", self._solve_fn)
+        for k in self.ladder:
+            fn(self.lu, np.zeros((self.lu.n, k), dtype=dt))
+
+    def close(self, flush: bool = True) -> None:
+        with self._cond:
+            self._closed = True
+            if not flush:
+                pending, self._pending = self._pending, []
+                for r in pending:
+                    r.future.cancel()
+            self._cond.notify()
+        self._flusher.join()
+
+    # -- flusher -------------------------------------------------------
+
+    def _run(self) -> None:
+        max_bucket = self.ladder[-1]
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending and self._closed:
+                    return
+                # linger until the widest bucket fills or the oldest
+                # request has waited max_linger_s.  A pending deadline
+                # that cannot outlast the linger window forfeits it:
+                # flush IMMEDIATELY, so the solve gets the whole
+                # remaining budget instead of being dispatched at (or
+                # dropped after) the deadline — tight-deadline traffic
+                # trades batch occupancy for latency by construction
+                flush_at = self._pending[0].t_submit + self.max_linger_s
+                while (len(self._pending) < max_bucket
+                       and not self._closed):
+                    tight = any(
+                        r.deadline is not None
+                        and r.deadline - _DEADLINE_FLUSH_MARGIN_S
+                        < flush_at
+                        for r in self._pending)
+                    if tight:
+                        break
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                batch = self._pending[:max_bucket]
+                del self._pending[:len(batch)]
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[_Request]) -> None:
+        now = time.monotonic()
+        live: list[_Request] = []
+        for r in batch:
+            if not r.future.set_running_or_notify_cancel():
+                continue                      # caller cancelled in queue
+            if r.deadline is not None and now > r.deadline:
+                self.metrics.inc("batcher.deadline_dropped")
+                r.future.set_exception(DeadlineExceeded(
+                    "deadline passed while queued"))
+                continue
+            self.metrics.observe("serve.queue_wait_s", now - r.t_submit)
+            live.append(r)
+        if not live:
+            return
+        t0 = time.monotonic()
+        k = bucket_for(len(live), self.ladder)
+        B = np.zeros((self.lu.n, k), dtype=self.dtype)
+        for j, r in enumerate(live):
+            B[:, j] = r.b
+        self.metrics.observe("serve.batch_assembly_s",
+                             time.monotonic() - t0)
+        self.metrics.observe("serve.batch_occupancy", len(live) / k)
+        self.metrics.inc("batcher.requests_solved", len(live))
+        t1 = time.monotonic()
+        try:
+            X = self._solve_fn(self.lu, B)
+        except BaseException as e:
+            for r in live:
+                r.future.set_exception(e)
+            return
+        self.metrics.observe("serve.device_solve_s",
+                             time.monotonic() - t1)
+        self.batches_dispatched += 1
+        done = time.monotonic()
+        for j, r in enumerate(live):
+            if r.deadline is not None and done > r.deadline:
+                # the work is done, but a missed deadline must never
+                # read as success — the caller already moved on
+                self.metrics.inc("batcher.deadline_missed")
+                r.future.set_exception(DeadlineExceeded(
+                    "solved after deadline"))
+            else:
+                r.future.set_result(np.array(X[:, j]))

@@ -1,0 +1,32 @@
+"""Failure vocabulary of the solve service.
+
+Every way a request can fail without being a solver bug is an explicit
+exception type, so callers (and the load generator's status taxonomy)
+can tell capacity pushback from deadline economics from cold-cache
+policy.  All derive from ServeError for blanket handling.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for service-level request failures."""
+
+
+class ServeRejected(ServeError):
+    """Admission control refused the request: the queue-depth cap was
+    reached.  Explicit pushback beats unbounded queueing — the caller
+    should shed or retry with backoff."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before a result was delivered.
+    A solve that COMPLETED after its deadline also raises this: a
+    deadline-missed request must never return a result marked
+    successful."""
+
+
+class FactorMissError(ServeError):
+    """Factor-cache miss under the fail-fast policy: this service is
+    configured not to pay a factorization inline (they cost ~500 s at
+    n=27k); prefactor() the key or use miss_policy='factor'."""

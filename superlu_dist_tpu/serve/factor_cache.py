@@ -1,0 +1,307 @@
+"""LRU factor cache with single-flight factorization.
+
+SOLVE_LATENCY.jsonl measured the economics this module exploits: one
+n=27k factorization costs ~477 s while a held-factor solve costs 59 ms
+(8.3 ms/rhs at nrhs=64).  A service must therefore keep
+`LUFactorization` handles resident and amortize them across every
+caller that presents the same matrix — and must never pay the same
+factorization twice because two requests raced on a cold key.
+
+Keys.  A matrix is fingerprinted in two tiers:
+
+  pattern key = sha1(m, n, indptr, indices)            — the symbolics
+  full key    = pattern key + sha1(values) + options.factor_key()
+                + the EFFECTIVE factor dtype
+
+The options leg is `Options.factor_key()` (options.py
+FACTOR_KEY_FIELDS): exactly the factorization-describing knobs.
+Solve-time knobs (trans, refinement) are merged per request by the
+FACTORED rung in models/gssvx.py and must not split entries.  The
+dtype in the key is `effective_factor_dtype` — a complex matrix with a
+real factor_dtype promotes, and the key must name the factors actually
+stored.
+
+Pattern tier.  On a full-key miss whose PATTERN key hits, the cached
+`FactorPlan` is reused and only the numeric phase runs — the
+`SamePattern_SameRowPerm` rung (SRC/superlu_defs.h:589-593): perms,
+scalings and the whole symbolic plan carry over, new values stream
+through `plan.scaled_values`.  That is the PDE-app refactorization
+path (same mesh, new coefficients) at plan-free cost.  Accuracy note:
+refinement runs per solve and its berr is exported to the
+`serve.berr` histogram, but the serve path never re-factors (no
+gssvx escalation rung) — values the inherited scaling serves poorly
+surface as an elevated berr there, and the remedy is a fresh
+full-key factorization (new Options or explicit prefactor), not a
+silent retry.
+
+Single-flight.  N concurrent misses on one key elect one leader that
+factors; the rest block on the flight and share the result (the
+standard groupcache discipline).  Counters expose hits / misses /
+pattern_hits / evictions / single_flight_waits / bytes_resident.
+
+Capacity is a byte bound over `query_space(lu)["held_bytes"]` —
+factors dominate (the n=27k f32 example holds ~GBs); plans ride along
+uncounted in the pattern tier with a separate entry bound.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..models.gssvx import (LUFactorization, effective_factor_dtype,
+                            factorize, query_space)
+from ..options import Options
+from ..plan.plan import plan_factorization
+from ..sparse import CSRMatrix
+from .errors import DeadlineExceeded
+from .metrics import Metrics
+
+
+def pattern_fingerprint(a: CSRMatrix) -> str:
+    """Symbolic identity: shape + CSR structure, values excluded."""
+    h = hashlib.sha1()
+    h.update(f"{a.m}x{a.n}".encode())
+    h.update(np.ascontiguousarray(a.indptr).tobytes())
+    h.update(np.ascontiguousarray(a.indices).tobytes())
+    return h.hexdigest()
+
+
+def values_fingerprint(a: CSRMatrix) -> str:
+    return hashlib.sha1(np.ascontiguousarray(a.data).tobytes()).hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    pattern: str
+    values: str
+    options: tuple
+
+    @property
+    def pattern_key(self) -> tuple:
+        # plan reuse is only sound when the plan-shaping options match
+        # too, so the pattern tier keys on (structure, options) and
+        # drops only the values leg
+        return (self.pattern, self.options)
+
+
+def matrix_key(a: CSRMatrix, options: Options | None = None) -> CacheKey:
+    options = options or Options()
+    eff_dtype = effective_factor_dtype(a.dtype, options.factor_dtype).name
+    return CacheKey(pattern=pattern_fingerprint(a),
+                    values=values_fingerprint(a),
+                    options=options.factor_key() + (eff_dtype,))
+
+
+class _Flight:
+    """One in-progress factorization; followers wait on the event."""
+
+    __slots__ = ("event", "lu", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.lu: Optional[LUFactorization] = None
+        self.error: Optional[BaseException] = None
+
+
+@dataclasses.dataclass
+class _Entry:
+    lu: LUFactorization
+    nbytes: int
+
+
+class FactorCache:
+    """Thread-safe LRU of LUFactorization handles + a plan tier.
+
+    `factorize_fn(a, options, plan)` is injectable for tests (count
+    invocations, simulate slow factorizations); the default runs the
+    real pipeline via models/gssvx.py.
+    """
+
+    def __init__(self, capacity_bytes: int | None = None,
+                 max_plans: int = 64,
+                 backend: str = "auto",
+                 metrics: Metrics | None = None,
+                 factorize_fn: Callable | None = None,
+                 on_evict: Callable | None = None) -> None:
+        self.capacity_bytes = capacity_bytes
+        self.max_plans = max_plans
+        self.backend = backend
+        self.metrics = metrics or Metrics()
+        self._factorize_fn = factorize_fn or self._default_factorize
+        # on_evict(key, lu) fires AFTER the cache lock is released for
+        # every LRU eviction — the service uses it to drop the evicted
+        # key's batchers, so eviction actually releases the factors
+        # instead of leaving them pinned by a flusher thread
+        self.on_evict = on_evict
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[CacheKey, _Entry]" = \
+            collections.OrderedDict()
+        self._plans: "collections.OrderedDict[tuple, object]" = \
+            collections.OrderedDict()
+        self._inflight: dict[CacheKey, _Flight] = {}
+        self.bytes_resident = 0
+
+    # -- introspection -------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        m = self.metrics
+        with self._lock:
+            resident = self.bytes_resident
+            entries = len(self._entries)
+            plans = len(self._plans)
+        hits = m.counter("factor_cache.hits")
+        misses = m.counter("factor_cache.misses")
+        total = hits + misses
+        return {
+            "entries": entries,
+            "plans": plans,
+            "bytes_resident": resident,
+            "hits": hits,
+            "misses": misses,
+            "pattern_hits": m.counter("factor_cache.pattern_hits"),
+            "evictions": m.counter("factor_cache.evictions"),
+            "single_flight_waits":
+                m.counter("factor_cache.single_flight_waits"),
+            "factorizations": m.counter("factor_cache.factorizations"),
+            "hit_rate": (hits / total) if total else 0.0,
+        }
+
+    # -- core ----------------------------------------------------------
+
+    def peek(self, key: CacheKey,
+             touch: bool = True) -> Optional[LUFactorization]:
+        """Lookup without hit/miss accounting (policy probes, keyed
+        submits).  touch=False also leaves the LRU order alone."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            if touch:
+                self._entries.move_to_end(key)
+            return ent.lu
+
+    def get(self, key: CacheKey) -> Optional[LUFactorization]:
+        """Plain lookup (counts a hit/miss, refreshes LRU position)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                self.metrics.inc("factor_cache.hits")
+                return ent.lu
+        self.metrics.inc("factor_cache.misses")
+        return None
+
+    def get_or_factorize(self, a: CSRMatrix,
+                         options: Options | None = None,
+                         key: CacheKey | None = None,
+                         deadline: float | None = None
+                         ) -> LUFactorization:
+        """Return resident factors for (a, options), factoring at most
+        once per key across all concurrent callers.
+
+        `deadline` (absolute time.monotonic()) bounds how long a
+        FOLLOWER waits on another caller's in-flight factorization
+        (DeadlineExceeded on expiry).  The leader deliberately ignores
+        it: its factorization is useful to every future caller of the
+        key, so abandoning it at the deadline would waste the work —
+        callers that cannot afford to lead use miss_policy='failfast'."""
+        options = options or Options()
+        key = key or matrix_key(a, options)
+        while True:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries.move_to_end(key)
+                    self.metrics.inc("factor_cache.hits")
+                    return ent.lu
+                flight = self._inflight.get(key)
+                if flight is None:
+                    flight = self._inflight[key] = _Flight()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                self.metrics.inc("factor_cache.single_flight_waits")
+                timeout = (None if deadline is None
+                           else max(0.0, deadline - time.monotonic()))
+                if not flight.event.wait(timeout):
+                    raise DeadlineExceeded(
+                        "deadline passed waiting on another caller's "
+                        "in-flight factorization")
+                if flight.error is not None:
+                    raise flight.error
+                if flight.lu is not None:
+                    return flight.lu
+                continue  # leader aborted without result; re-elect
+            return self._lead_factorization(a, options, key, flight)
+
+    def _lead_factorization(self, a, options, key, flight):
+        self.metrics.inc("factor_cache.misses")
+        try:
+            plan = None
+            with self._lock:
+                plan = self._plans.get(key.pattern_key)
+                if plan is not None:
+                    self._plans.move_to_end(key.pattern_key)
+            if plan is not None:
+                self.metrics.inc("factor_cache.pattern_hits")
+            self.metrics.inc("factor_cache.factorizations")
+            lu = self._factorize_fn(a, options, plan)
+            self.put(key, lu)
+            flight.lu = lu
+            return lu
+        except BaseException as e:
+            flight.error = e
+            raise
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            flight.event.set()
+
+    def _default_factorize(self, a, options, plan):
+        if plan is None:
+            plan = plan_factorization(a, options)
+        return factorize(a, options, plan=plan, backend=self.backend)
+
+    def put(self, key: CacheKey, lu: LUFactorization) -> None:
+        """Insert factors (and their plan into the pattern tier),
+        evicting least-recently-used entries past the byte bound."""
+        try:
+            nbytes = int(query_space(lu)["held_bytes"])
+        except Exception:
+            nbytes = int(getattr(lu.stats, "lu_bytes", 0) or 0)
+        evicted: list[tuple[CacheKey, _Entry]] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_resident -= old.nbytes
+            self._entries[key] = _Entry(lu=lu, nbytes=nbytes)
+            self.bytes_resident += nbytes
+            self._plans[key.pattern_key] = lu.plan
+            self._plans.move_to_end(key.pattern_key)
+            while len(self._plans) > self.max_plans:
+                self._plans.popitem(last=False)
+            if self.capacity_bytes is not None:
+                # never evict the entry just inserted: an oversized
+                # single factorization stays resident (the service has
+                # nothing cheaper to serve it from)
+                while (self.bytes_resident > self.capacity_bytes
+                       and len(self._entries) > 1):
+                    ek, ee = self._entries.popitem(last=False)
+                    self.bytes_resident -= ee.nbytes
+                    self.metrics.inc("factor_cache.evictions")
+                    evicted.append((ek, ee))
+        if self.on_evict is not None:
+            for ek, ee in evicted:
+                self.on_evict(ek, ee.lu)

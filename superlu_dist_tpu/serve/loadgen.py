@@ -1,0 +1,122 @@
+"""Closed-loop load generator for the solve service.
+
+`concurrency` worker threads each run a closed loop: draw a think
+time from an exponential distribution (Poisson arrivals per worker
+when `rate_hz` is set; zero think time = maximum pressure), pick a
+matrix key by skew, issue a blocking solve, record (latency, status).
+Key skew models multi-tenant traffic: with probability `hot_fraction`
+a request hits key 0, else a uniform draw over the rest — so cache
+hits, LRU churn and per-key batching are all exercised by one knob.
+
+Everything is seeded; the same load spec replays the same request
+sequence (modulo thread scheduling), which keeps the tier-1 serve
+test deterministic enough to assert on.
+
+The report is JSON-ready: per-status counts, latency percentiles in
+milliseconds, wall-clock solves/s, and the service metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .errors import DeadlineExceeded, FactorMissError, ServeRejected
+from .service import SolveService
+
+
+def run_load(service: SolveService, matrices, *,
+             requests: int = 128, concurrency: int = 8,
+             rate_hz: float | None = None,
+             hot_fraction: float = 1.0,
+             deadline_s: float | None = None,
+             options=None,
+             seed: int = 0) -> dict:
+    """Drive `requests` total solves through `service` from
+    `concurrency` closed-loop workers; returns the report dict.
+
+    `matrices` is a list of (CSRMatrix | CacheKey); index 0 is the hot
+    key.  Workers split the request count evenly (remainder to the
+    first workers)."""
+    matrices = list(matrices)
+    n_workers = min(concurrency, requests)
+    counts = [requests // n_workers] * n_workers
+    for i in range(requests % n_workers):
+        counts[i] += 1
+    results: list[tuple[float, str]] = []
+    res_lock = threading.Lock()
+
+    def rhs_dim(m):
+        # CacheKey carries no n; workers size the RHS off the resident
+        # factors instead
+        if hasattr(m, "n"):
+            return m.n
+        lu = service.cache.peek(m, touch=False)
+        if lu is None:
+            raise ValueError("CacheKey target must be prefactored")
+        return lu.n
+
+    dims = [rhs_dim(m) for m in matrices]
+
+    def worker(wid: int, n_req: int) -> None:
+        rng = np.random.default_rng(seed * 1009 + wid)
+        for _ in range(n_req):
+            if rate_hz:
+                time.sleep(rng.exponential(n_workers / rate_hz))
+            if len(matrices) == 1 or rng.random() < hot_fraction:
+                mi = 0
+            else:
+                mi = 1 + int(rng.integers(len(matrices) - 1))
+            b = rng.standard_normal(dims[mi])
+            t0 = time.monotonic()
+            try:
+                x = service.solve(matrices[mi], b, options=options,
+                                  deadline_s=deadline_s)
+                status = ("ok" if np.all(np.isfinite(x))
+                          else "nonfinite")
+            except ServeRejected:
+                status = "rejected"
+            except DeadlineExceeded:
+                status = "deadline"
+            except FactorMissError:
+                status = "miss_failfast"
+            except Exception:
+                # a worker must never die silently: an unexpected
+                # error (solver failure re-raised from a batch future,
+                # shape/dtype rejection) is a recorded outcome, not a
+                # truncated report
+                status = "error"
+            with res_lock:
+                results.append((time.monotonic() - t0, status))
+
+    threads = [threading.Thread(target=worker, args=(i, c), daemon=True)
+               for i, c in enumerate(counts)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+
+    by_status: dict[str, int] = {}
+    for _, s in results:
+        by_status[s] = by_status.get(s, 0) + 1
+    from .metrics import nearest_rank
+    ok_lat = np.array(sorted(lat for lat, s in results if s == "ok"))
+    report = {
+        "requests": requests,
+        "concurrency": n_workers,
+        "hot_fraction": hot_fraction,
+        "wall_s": wall_s,
+        "by_status": by_status,
+        "solves_per_s": (len(ok_lat) / wall_s) if wall_s > 0 else 0.0,
+        "metrics": service.metrics.snapshot(),
+    }
+    if len(ok_lat):
+        def pct(p):
+            return nearest_rank(ok_lat, p) * 1e3
+        report.update(p50_ms=pct(50), p95_ms=pct(95), p99_ms=pct(99),
+                      mean_ms=float(ok_lat.mean()) * 1e3)
+    return report

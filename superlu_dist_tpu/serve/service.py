@@ -1,0 +1,333 @@
+"""The solve-service front door.
+
+`SolveService` turns the batch-shaped solver into a multi-tenant
+request/response service: requests name a matrix (by value or by a
+precomputed cache key), the service resolves factors through the LRU
+factor cache (single-flight on misses), routes the RHS into the
+per-key micro-batcher, and enforces the two service-level contracts a
+caller can rely on:
+
+  * admission control — at most `max_queue_depth` requests in flight;
+    request N+1 gets an immediate ServeRejected instead of unbounded
+    queueing (explicit pushback is the only honest overload signal);
+  * deadlines — a request carries an absolute deadline; it is dropped
+    from batch assembly once passed, and a solve that lands late
+    raises DeadlineExceeded rather than returning a stale success.
+
+Cold keys follow `miss_policy`: "factor" pays the factorization once
+(single-flight, so a thundering herd on one key does one
+factorization's worth of work); "failfast" raises FactorMissError so
+interactive traffic never blocks ~500 s behind a cold tenant — the
+operator prefactors keys out of band via `prefactor()`.
+
+Everything is observable through a shared Metrics registry; the
+snapshot feeds SERVE_LATENCY.jsonl (tools/serve_bench.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..models.gssvx import LUFactorization, solve
+from ..options import Options, merge_solve_options, solve_options_key
+from ..sparse import CSRMatrix
+from .batcher import BUCKET_LADDER, MicroBatcher
+from .errors import (DeadlineExceeded, FactorMissError, ServeError,
+                     ServeRejected)
+from .factor_cache import CacheKey, FactorCache, matrix_key
+from .metrics import Metrics
+
+
+def _merged_solve_fn(options: Options, metrics: Metrics | None = None):
+    """Batch solver honoring the request's SOLVE-TIME knobs: the
+    gssvx FACTORED-rung merge, applied per dispatch.  The replace copy
+    shares the handle's refine_cache container, so refinement
+    operands build once across all variants.
+
+    Per-dispatch berr is exported to the `serve.berr` histogram: the
+    serve path never re-factors (no gssvx escalation rung), so a
+    pattern-tier refactorization whose inherited scaling serves the
+    new values poorly shows up HERE, not as an exception — alert on
+    this histogram."""
+    from ..options import IterRefine
+    from ..utils.stats import Stats
+
+    def raw(lu: LUFactorization, B):
+        merged = merge_solve_options(lu.effective_options, options)
+        st = Stats()
+        x = solve(dataclasses.replace(lu, options=merged), B, stats=st)
+        return x, st, merged
+
+    def fn(lu: LUFactorization, B):
+        x, st, merged = raw(lu, B)
+        if (metrics is not None
+                and merged.iter_refine != IterRefine.NOREFINE):
+            metrics.observe("serve.berr", float(st.berr))
+            if st.refine_steps:
+                metrics.observe("serve.refine_steps",
+                                float(st.refine_steps))
+        return x
+
+    # warmup path: same compiled programs, no metrics — five
+    # synthetic berr=0 samples per prefactor would dilute the very
+    # histogram operators alert on
+    fn.warmup_fn = lambda lu, B: raw(lu, B)[0]
+    return fn
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Service policy knobs (the serving analog of Options)."""
+
+    max_queue_depth: int = 256          # admission cap, requests in flight
+    default_deadline_s: float | None = None   # per-request default
+    miss_policy: str = "factor"         # "factor" | "failfast"
+    max_linger_s: float = 0.002         # batcher flush timer
+    ladder: tuple = BUCKET_LADDER
+    capacity_bytes: int | None = None   # factor-cache byte bound
+    backend: str = "auto"
+    # cap on live (key, solve-options) batcher variants — each owns a
+    # flusher thread; least-recently-used variants retire past the cap
+    max_batchers: int = 64
+
+
+class SolveService:
+    def __init__(self, config: ServeConfig | None = None,
+                 metrics: Metrics | None = None,
+                 cache: FactorCache | None = None) -> None:
+        self.config = config or ServeConfig()
+        if self.config.miss_policy not in ("factor", "failfast"):
+            raise ValueError(
+                f"unknown miss_policy {self.config.miss_policy!r}")
+        self.metrics = metrics or Metrics()
+        # `is not None`, not truthiness: an EMPTY FactorCache has
+        # len()==0 and would be silently replaced
+        self.cache = cache if cache is not None else FactorCache(
+            capacity_bytes=self.config.capacity_bytes,
+            backend=self.config.backend, metrics=self.metrics)
+        if self.cache.on_evict is None:
+            # an evicted key's batchers must die with it, or their
+            # flusher threads pin the factors the byte bound claims to
+            # have released
+            self.cache.on_evict = self._on_evict
+        self._lock = threading.Lock()
+        # keyed by (CacheKey, solve-time option values): requests
+        # differing in trans/refinement share the FACTORS but cannot
+        # share a batch — each variant batches (and warms) separately.
+        # LRU-ordered and capped (config.max_batchers): every variant
+        # owns a flusher thread, and an unbounded option sweep must
+        # not grow threads for the process lifetime
+        self._batchers: "collections.OrderedDict[tuple, MicroBatcher]" \
+            = collections.OrderedDict()
+        # options each key was prefactored with: keyed submits that
+        # omit options get the PREFACTORED solve semantics (and its
+        # warmed batcher variant), not silently-different defaults
+        self._prefactor_opts: dict[CacheKey, Options] = {}
+        self._inflight = 0
+        self._closed = False
+
+    # -- operator surface ---------------------------------------------
+
+    def prefactor(self, a: CSRMatrix, options: Options | None = None
+                  ) -> CacheKey:
+        """Warm a key out of band: factorize (single-flight), then
+        compile every ladder bucket for the requested solve options so
+        first live traffic on this key runs recompile-free.  Returns
+        the key for keyed submits."""
+        with self._lock:
+            if self._closed:
+                raise ServeError("service is closed")
+        options = options or Options()
+        key = matrix_key(a, options)
+        lu = self.cache.get_or_factorize(a, options, key=key)
+        with self._lock:
+            self._prefactor_opts[key] = options
+        self._batcher_for(key, lu, options).warmup()
+        return key
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close()
+
+    # -- request path --------------------------------------------------
+
+    def submit(self, a: CSRMatrix | CacheKey, b: np.ndarray,
+               options: Options | None = None,
+               deadline_s: float | None = None) -> Future:
+        """Admit one solve request; resolves to x.  `a` may be the
+        matrix itself or a CacheKey from prefactor() (keyed submits
+        skip fingerprint hashing on the hot path)."""
+        with self._lock:
+            if self._closed:
+                raise ServeError("service is closed")
+            if self._inflight >= self.config.max_queue_depth:
+                self.metrics.inc("serve.rejected")
+                raise ServeRejected(
+                    f"queue depth {self._inflight} at cap "
+                    f"{self.config.max_queue_depth}")
+            self._inflight += 1
+        try:
+            future = self._route(a, b, options, deadline_s)
+        except BaseException:
+            with self._lock:
+                self._inflight -= 1
+            raise
+        future.add_done_callback(self._release)
+        return future
+
+    def solve(self, a: CSRMatrix | CacheKey, b: np.ndarray,
+              options: Options | None = None,
+              deadline_s: float | None = None) -> np.ndarray:
+        """Blocking submit; respects the deadline while waiting."""
+        deadline_s = (deadline_s if deadline_s is not None
+                      else self.config.default_deadline_s)
+        t0 = time.monotonic()
+        future = self.submit(a, b, options, deadline_s)
+        timeout = None
+        if deadline_s is not None:
+            timeout = max(0.0, t0 + deadline_s - time.monotonic())
+        try:
+            x = future.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            self.metrics.inc("serve.deadline_missed")
+            raise DeadlineExceeded(
+                f"no result within {deadline_s:.3f}s") from None
+        self.metrics.observe("serve.e2e_latency_s",
+                             time.monotonic() - t0)
+        return x
+
+    # -- internals -----------------------------------------------------
+
+    def _release(self, _future) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def _route(self, a, b, options, deadline_s) -> Future:
+        deadline_s = (deadline_s if deadline_s is not None
+                      else self.config.default_deadline_s)
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        if isinstance(a, CacheKey):
+            key = a
+            # get(), not peek(): keyed submits ARE the hot path, and
+            # the recorded hit rate must reflect them
+            lu = self.cache.get(key)
+            if lu is None:
+                raise FactorMissError(
+                    "keyed submit for a key no longer resident; "
+                    "prefactor() it again")
+            if options is None:
+                # a keyed submit without options means "as
+                # prefactored" — same solve semantics, same warmed
+                # batcher variant (a default-Options fallback here
+                # would hit an UNWARMED variant and recompile inline)
+                with self._lock:
+                    options = self._prefactor_opts.get(key)
+        else:
+            key = matrix_key(a, options or Options())
+            resident = self.cache.peek(key, touch=False) is not None
+            if not resident and self.config.miss_policy == "failfast":
+                self.metrics.inc("serve.miss_failfast")
+                raise FactorMissError(
+                    f"cold key under failfast policy (pattern "
+                    f"{key.pattern[:12]})")
+            # "factor" policy: pay it here, once — concurrent misses
+            # on this key coalesce into the leader's factorization.
+            # Followers respect the request deadline while waiting;
+            # the leader runs to completion (see get_or_factorize)
+            lu = self.cache.get_or_factorize(a, options, key=key,
+                                             deadline=deadline)
+        mb = self._batcher_for(key, lu, options or Options())
+        try:
+            return mb.submit(b, deadline=deadline)
+        except ServeError:
+            # the batcher was retired by a concurrent eviction between
+            # lookup and submit; the factors are gone — same contract
+            # as a cold keyed submit
+            raise FactorMissError(
+                "factors evicted concurrently; resubmit (or "
+                "prefactor) to re-factor") from None
+
+    def _batcher_for(self, key: CacheKey, lu: LUFactorization,
+                     options: Options) -> MicroBatcher:
+        """One MicroBatcher per (cache key, solve-time options).  Its
+        solve_fn merges the request's solve knobs onto the shared
+        handle (the gssvx FACTORED rung's merge) so the leader's
+        factorization-time knobs never leak into other callers'
+        solves — and requests with different trans/refinement never
+        land in the same batch."""
+        bkey = (key,) + solve_options_key(options)
+        retired = []
+        with self._lock:
+            if self._closed:
+                # close() may race a submit that already passed
+                # admission; never resurrect a batcher on a closed
+                # service
+                raise ServeError("service is closed")
+            mb = self._batchers.get(bkey)
+            if mb is not None:
+                self._batchers.move_to_end(bkey)
+            else:
+                # residency check under the service lock: _on_evict
+                # (which also takes this lock, strictly AFTER the
+                # cache entry is gone) either sees the batcher we
+                # insert here and retires it, or we see the eviction
+                # and refuse — no orphan batcher can pin evicted
+                # factors
+                if self.cache.peek(key, touch=False) is None:
+                    raise FactorMissError(
+                        "factors evicted concurrently; resubmit to "
+                        "re-factor")
+                mb = self._batchers[bkey] = MicroBatcher(
+                    lu, max_linger_s=self.config.max_linger_s,
+                    ladder=self.config.ladder, metrics=self.metrics,
+                    solve_fn=_merged_solve_fn(options, self.metrics))
+                while len(self._batchers) > self.config.max_batchers:
+                    _, old = self._batchers.popitem(last=False)
+                    retired.append(old)
+        for old in retired:
+            old.close(flush=True)
+        return mb
+
+    def _on_evict(self, key: CacheKey, _lu) -> None:
+        """Factor-cache eviction hook: retire every batcher variant of
+        the evicted key (flush first — queued requests still hold the
+        handle and complete; new traffic re-factors)."""
+        with self._lock:
+            victims = [bk for bk in self._batchers if bk[0] == key]
+            batchers = [self._batchers.pop(bk) for bk in victims]
+            self._prefactor_opts.pop(key, None)
+        for mb in batchers:
+            mb.close(flush=True)
+
+
+def solve_jit_cache_size(lu: LUFactorization) -> int:
+    """Number of compiled entries in the jitted solve program serving
+    this handle — the recompile pin for the zero-recompiles-after-
+    warmup contract (tests assert it is flat across a load run).
+    Returns -1 when the handle has no single jitted solve program
+    (host backend, staged per-group execution)."""
+    if lu.backend != "jax" or lu.device_lu is None:
+        return -1
+    from ..ops import batched
+    d = lu.device_lu
+    if isinstance(d, batched.StagedLU):
+        return -1
+    _, solve_fn = batched._phase_fns(
+        d.schedule, d.dtype, batched._thresh_for(lu.plan, d.dtype),
+        pair=batched._lu_is_pair(d))
+    try:
+        return int(solve_fn._cache_size())
+    except AttributeError:
+        return -1

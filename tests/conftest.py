@@ -52,13 +52,25 @@ def pytest_configure(config):
         "sweep: bench-sweep plumbing runs (spawn real bench "
         "subprocesses, ~5 min) — excluded from the default suite; "
         "run with `pytest -m sweep`")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy serve/load tests (minutes of wall clock) — "
+        "excluded from tier-1 (`-m 'not slow'`) and from the default "
+        "suite; run with `pytest -m slow`")
 
 
 def pytest_collection_modifyitems(config, items):
     import pytest
-    if config.getoption("-m"):
-        return            # explicit -m selection is honored as given
-    for name in ("scale", "sweep"):
+    expr = config.getoption("-m") or ""
+    for name in ("scale", "sweep", "slow"):
+        if name in expr:
+            # the caller's -m expression names this marker — pytest's
+            # own selection decides (so `-m scale` opts in, and
+            # `-m 'not slow'` deselects).  Markers NOT named in the
+            # expression still get the default opt-out below: tier-1's
+            # `-m 'not slow'` must not accidentally run the 30-minute
+            # scale certification.
+            continue
         skip = pytest.mark.skip(reason=f"{name} run: opt in with "
                                        f"-m {name}")
         for item in items:

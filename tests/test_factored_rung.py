@@ -1,0 +1,132 @@
+"""The DoFact.FACTORED reuse rung, pinned: the solve-only path must
+NEVER silently re-factor — across nrhs widths, rhs dtypes and factor
+dtypes — because the serve layer's whole economics (477 s factor vs
+59 ms solve) stand on it."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import (Fact, IterRefine, Options, Stats, gssvx,
+                              solve)
+import importlib
+
+gssvx_mod = importlib.import_module("superlu_dist_tpu.models.gssvx")
+from superlu_dist_tpu.utils.testmat import helmholtz_2d, laplacian_2d
+
+
+def _no_refactor_guard(monkeypatch):
+    """Arm factorize() to explode: any call after this is a silent
+    re-factorization of the rung under test."""
+    def boom(*a, **kw):
+        raise AssertionError(
+            "FACTORED rung called factorize() — solve-only must never "
+            "re-pay the factorization")
+    monkeypatch.setattr(gssvx_mod, "factorize", boom)
+
+
+@pytest.mark.parametrize("backend", ["host", "jax"])
+@pytest.mark.parametrize("nrhs", [1, 3, 8])
+def test_factored_rung_never_refactors_across_nrhs(monkeypatch,
+                                                   backend, nrhs):
+    a = laplacian_2d(6)
+    b1 = np.ones(a.n)
+    x0, lu, _ = gssvx(Options(), a, b1, backend=backend)
+    _no_refactor_guard(monkeypatch)
+    dense = a.to_scipy().toarray()
+    rng = np.random.default_rng(nrhs)
+    b = rng.standard_normal((a.n, nrhs)) if nrhs > 1 \
+        else rng.standard_normal(a.n)
+    stats = Stats()
+    x, lu2, _ = gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+                      stats=stats, backend=backend)
+    np.testing.assert_allclose(
+        x, np.linalg.solve(dense, b), rtol=1e-9)
+    # the reused handle is the caller's (options-merged copy shares
+    # the factors), and no FACT time was booked on this call's stats
+    assert stats.utime.get("FACT", 0.0) == 0.0
+    assert stats.utime.get("SOLVE", 0.0) > 0.0
+
+
+@pytest.mark.parametrize("factor_dtype,rhs_dtype", [
+    ("float64", np.float64),
+    ("float32", np.float64),
+    ("float32", np.float32),
+    ("float64", np.complex128),
+])
+def test_factored_rung_across_dtypes(monkeypatch, factor_dtype,
+                                     rhs_dtype):
+    a = laplacian_2d(6)
+    x0, lu, _ = gssvx(Options(factor_dtype=factor_dtype), a,
+                      np.ones(a.n), backend="host")
+    _no_refactor_guard(monkeypatch)
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(a.n).astype(rhs_dtype)
+    if np.issubdtype(rhs_dtype, np.complexfloating):
+        b = b + 1j * rng.standard_normal(a.n)
+    stats = Stats()
+    x, _, _ = gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+                    stats=stats, backend="host")
+    tol = 1e-4 if factor_dtype == "float32" \
+        and np.dtype(rhs_dtype).itemsize <= 4 else 1e-8
+    np.testing.assert_allclose(
+        x, np.linalg.solve(a.to_scipy().toarray(), b), rtol=tol)
+    assert stats.utime.get("FACT", 0.0) == 0.0
+
+
+def test_factored_complex_system(monkeypatch):
+    h = helmholtz_2d(5)
+    x0, lu, _ = gssvx(Options(), h, np.ones(h.n), backend="host")
+    _no_refactor_guard(monkeypatch)
+    b = np.ones(h.n, dtype=np.complex128) * (1 + 2j)
+    x, _, _ = gssvx(Options(fact=Fact.FACTORED), h, b, lu=lu,
+                    backend="host")
+    np.testing.assert_allclose(
+        x, np.linalg.solve(h.to_scipy().toarray(), b), rtol=1e-9)
+
+
+def test_factored_rung_no_escalation(monkeypatch):
+    """Escalation must not fire on the solve-only rung even when berr
+    stalls (it would discard the caller's held factors)."""
+    a = laplacian_2d(6)
+    _, lu, _ = gssvx(Options(factor_dtype="float32"), a, np.ones(a.n),
+                     backend="host")
+    _no_refactor_guard(monkeypatch)
+    # force the would-escalate verdict: only the FACTORED guard in
+    # _should_escalate may now stand between the rung and a refactor
+    monkeypatch.setattr(gssvx_mod, "_escalation_core",
+                        lambda *a, **kw: True)
+    stats = Stats()
+    opts = Options(fact=Fact.FACTORED, factor_dtype="float32")
+    gssvx(opts, a, np.ones(a.n), lu=lu, stats=stats, backend="host")
+    assert stats.escalations == 0
+
+
+def test_factored_requires_handle():
+    a = laplacian_2d(5)
+    with pytest.raises(ValueError, match="requires"):
+        gssvx(Options(fact=Fact.FACTORED), a, np.ones(a.n))
+
+
+def test_warm_solve_smoke():
+    """warm_solve pre-runs the solve programs for the given widths and
+    leaves the handle's results unchanged."""
+    from superlu_dist_tpu import warm_solve
+    a = laplacian_2d(6)
+    _, lu, _ = gssvx(Options(), a, np.ones(a.n), backend="host")
+    x_before = solve(lu, np.ones(a.n))
+    warm_solve(lu, (1, 3))
+    np.testing.assert_array_equal(solve(lu, np.ones(a.n)), x_before)
+
+
+def test_solve_only_entry_point_matches_gssvx():
+    """The serve layer uses solve(lu, B) directly; it must agree with
+    the gssvx FACTORED rung bit-for-bit on the same handle."""
+    a = laplacian_2d(6)
+    b = np.linspace(0, 1, a.n)
+    _, lu, _ = gssvx(Options(), a, np.ones(a.n), backend="host")
+    x_direct = solve(lu, b)
+    x_gssvx, _, _ = gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+                          backend="host")
+    np.testing.assert_array_equal(x_direct, x_gssvx)

@@ -1,0 +1,55 @@
+"""flags.py registry integrity: every SLU_* token in the package,
+tools/ and bench.py must be documented (or explicitly listed as a
+non-flag token), and the registry must not carry stale entries."""
+
+import os
+import re
+
+from superlu_dist_tpu.flags import FLAGS, NON_FLAG_TOKENS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOKEN = re.compile(r"SLU_[A-Z_0-9]*")
+
+
+def _source_files():
+    yield os.path.join(ROOT, "bench.py")
+    for top in ("superlu_dist_tpu", "tools"):
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(ROOT, top)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in filenames:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _tokens():
+    found = {}
+    for path in _source_files():
+        if os.path.basename(path) == "flags.py":
+            continue        # the registry itself names every flag
+        text = open(path).read()
+        for tok in _TOKEN.findall(text):
+            found.setdefault(tok, os.path.relpath(path, ROOT))
+    return found
+
+
+def test_every_flag_read_is_documented():
+    found = _tokens()
+    undocumented = {t: p for t, p in found.items()
+                    if t not in FLAGS and t not in NON_FLAG_TOKENS}
+    assert not undocumented, (
+        f"undocumented SLU_* flags (add to superlu_dist_tpu/flags.py "
+        f"FLAGS with a one-line description): {undocumented}")
+
+
+def test_no_stale_registry_entries():
+    found = set(_tokens())
+    stale = sorted(f for f in FLAGS if f not in found)
+    assert not stale, (
+        f"flags.py documents flags no source file reads: {stale}")
+
+
+def test_descriptions_are_one_line_and_nonempty():
+    for name, desc in FLAGS.items():
+        assert desc.strip() and "\n" not in desc, name
+    assert not (set(FLAGS) & NON_FLAG_TOKENS)

@@ -1,0 +1,152 @@
+"""serve/batcher.py: bucket ladder, coalescing, flush policy,
+deadline handling, and correctness of de-batched solutions."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options, factorize
+from superlu_dist_tpu.serve import (BUCKET_LADDER, DeadlineExceeded,
+                                    Metrics, MicroBatcher, bucket_for)
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+
+def test_bucket_ladder_padding():
+    assert bucket_for(1) == 1
+    assert bucket_for(2) == 8
+    assert bucket_for(8) == 8
+    assert bucket_for(9) == 16
+    assert bucket_for(33) == 64
+    assert bucket_for(64) == 64
+    # over-wide requests clamp to the top bucket (caller splits)
+    assert bucket_for(100) == 64
+    assert BUCKET_LADDER == (1, 8, 16, 32, 64)
+
+
+@pytest.fixture(scope="module")
+def lu():
+    a = laplacian_2d(6)
+    return factorize(a, Options(), backend="host")
+
+
+def test_batched_solutions_match_direct(lu):
+    """Concurrent submits coalesce into fewer dispatches and each
+    caller gets ITS solution back (column routing is the bug surface
+    here)."""
+    n = lu.n
+    m = Metrics()
+    mb = MicroBatcher(lu, max_linger_s=0.05, metrics=m)
+    rng = np.random.default_rng(0)
+    bs = [rng.standard_normal(n) for _ in range(12)]
+    futures = [mb.submit(b) for b in bs]
+    xs = [f.result(timeout=30) for f in futures]
+    mb.close()
+    dense = lu.a.to_scipy().toarray()
+    for b, x in zip(bs, xs):
+        np.testing.assert_allclose(x, np.linalg.solve(dense, b),
+                                   rtol=1e-9)
+    # 12 requests in a 0.05 s linger window: strictly fewer dispatches
+    # than requests, occupancy recorded
+    assert mb.batches_dispatched < 12
+    assert m.counter("batcher.requests_solved") == 12
+    occ = m.histogram("serve.batch_occupancy")
+    assert occ["count"] == mb.batches_dispatched
+    assert occ["max"] > 1.0 / 16.0    # at least one true multi-rhs batch
+
+
+def test_linger_flush_fires_without_full_bucket(lu):
+    mb = MicroBatcher(lu, max_linger_s=0.01)
+    t0 = time.monotonic()
+    f = mb.submit(np.ones(lu.n))
+    x = f.result(timeout=30)
+    elapsed = time.monotonic() - t0
+    mb.close()
+    assert np.all(np.isfinite(x))
+    # flushed by the linger timer (well before any 30 s fallback), but
+    # not before the linger window opened
+    assert elapsed < 10.0
+
+
+def test_deadline_dropped_in_queue(lu):
+    """A request whose deadline passed while queued is dropped at
+    assembly — and a missed deadline NEVER yields a success."""
+    m = Metrics()
+    # long linger so the request sits in the queue past its deadline
+    mb = MicroBatcher(lu, max_linger_s=0.2, metrics=m)
+    f = mb.submit(np.ones(lu.n), deadline=time.monotonic() - 0.001)
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=30)
+    mb.close()
+    assert m.counter("batcher.deadline_dropped") == 1
+    assert m.counter("batcher.requests_solved") == 0
+
+
+def test_tight_deadline_flushes_early(lu):
+    """A deadline tighter than the linger window forces an early
+    flush: the solve is ATTEMPTED (and succeeds when fast) instead of
+    the request being deterministically dropped at assembly."""
+    mb = MicroBatcher(lu, max_linger_s=0.5)   # linger >> deadline
+    f = mb.submit(np.ones(lu.n), deadline=time.monotonic() + 0.2)
+    x = f.result(timeout=30)                  # well before the 0.5 s linger
+    mb.close()
+    assert np.all(np.isfinite(x))
+
+
+def test_late_solve_is_not_success(lu):
+    """Deadline passes DURING the solve: the computed result must be
+    withheld and the future must fail."""
+    m = Metrics()
+
+    def slow_solve(lu_, B):
+        time.sleep(0.05)
+        from superlu_dist_tpu import solve
+        return solve(lu_, B)
+
+    mb = MicroBatcher(lu, max_linger_s=0.0, metrics=m,
+                      solve_fn=slow_solve)
+    f = mb.submit(np.ones(lu.n), deadline=time.monotonic() + 0.01)
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=30)
+    mb.close()
+    assert m.counter("batcher.deadline_missed") == 1
+
+
+def test_solver_error_propagates_to_all(lu):
+    def broken_solve(lu_, B):
+        raise ValueError("synthetic solver failure")
+
+    mb = MicroBatcher(lu, max_linger_s=0.05, solve_fn=broken_solve)
+    futures = [mb.submit(np.ones(lu.n)) for _ in range(3)]
+    for f in futures:
+        with pytest.raises(ValueError, match="synthetic"):
+            f.result(timeout=30)
+    mb.close()
+
+
+def test_rhs_shape_validation(lu):
+    mb = MicroBatcher(lu)
+    with pytest.raises(ValueError, match="rhs must be"):
+        mb.submit(np.ones(lu.n + 1))
+    with pytest.raises(ValueError, match="rhs must be"):
+        mb.submit(np.ones((lu.n, 2)))
+    mb.close()
+
+
+def test_close_flushes_pending(lu):
+    mb = MicroBatcher(lu, max_linger_s=5.0)   # linger longer than test
+    f = mb.submit(np.ones(lu.n))
+    mb.close(flush=True)                      # must not wait 5 s
+    assert np.all(np.isfinite(f.result(timeout=1)))
+
+
+def test_burst_larger_than_top_bucket_splits(lu):
+    """65+ concurrent requests split into multiple ≤64 dispatches and
+    all resolve."""
+    mb = MicroBatcher(lu, max_linger_s=0.05)
+    futures = [mb.submit(np.full(lu.n, float(i))) for i in range(70)]
+    xs = [f.result(timeout=60) for f in futures]
+    mb.close()
+    assert mb.batches_dispatched >= 2
+    assert all(np.all(np.isfinite(x)) for x in xs)

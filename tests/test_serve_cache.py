@@ -1,0 +1,236 @@
+"""serve/factor_cache.py: fingerprints, LRU eviction, the pattern
+tier, and the single-flight guarantee (N concurrent misses on one key
+pay ONE factorization — the 477 s duplicate-factorization hazard)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options, gssvx, solve
+from superlu_dist_tpu.serve import FactorCache, matrix_key
+from superlu_dist_tpu.serve.factor_cache import (pattern_fingerprint,
+                                                 values_fingerprint)
+from superlu_dist_tpu.utils.testmat import laplacian_2d, laplacian_3d
+
+
+def _scaled(a, factor):
+    import dataclasses
+    return dataclasses.replace(a, data=a.data * factor)
+
+
+def test_key_tiers_distinguish_pattern_values_options():
+    a = laplacian_2d(6)
+    k0 = matrix_key(a, Options())
+    # same matrix, same options -> identical key
+    assert matrix_key(a, Options()) == k0
+    # same pattern, new values -> values leg differs, pattern leg same
+    k1 = matrix_key(_scaled(a, 2.0), Options())
+    assert k1 != k0 and k1.pattern == k0.pattern
+    assert k1.pattern_key == k0.pattern_key
+    # different pattern -> pattern leg differs
+    k2 = matrix_key(laplacian_2d(7), Options())
+    assert k2.pattern != k0.pattern
+    # factorization-describing option -> options leg differs
+    k3 = matrix_key(a, Options(factor_dtype="float32"))
+    assert k3 != k0
+    # solve-time knobs must NOT split entries (the FACTORED rung
+    # merges them per request)
+    from superlu_dist_tpu import IterRefine, Trans
+    k4 = matrix_key(a, Options(trans=Trans.TRANS,
+                               iter_refine=IterRefine.NOREFINE,
+                               max_refine_steps=3))
+    assert k4 == k0
+
+
+def test_effective_dtype_in_key():
+    # a complex matrix with a real factor_dtype promotes; the key must
+    # name the factors actually stored, so real/complex same-pattern
+    # systems never collide
+    from superlu_dist_tpu.utils.testmat import helmholtz_2d
+    h = helmholtz_2d(5)
+    kc = matrix_key(h, Options(factor_dtype="float64"))
+    assert "complex128" in repr(kc.options)
+
+
+def test_fingerprints_are_value_and_structure_hashes():
+    a = laplacian_2d(5)
+    assert pattern_fingerprint(a) == pattern_fingerprint(_scaled(a, 3.0))
+    assert values_fingerprint(a) != values_fingerprint(_scaled(a, 3.0))
+
+
+def test_get_or_factorize_hit_and_solve():
+    a = laplacian_2d(6)
+    cache = FactorCache(backend="host")
+    lu1 = cache.get_or_factorize(a, Options())
+    lu2 = cache.get_or_factorize(a, Options())
+    assert lu1 is lu2
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert st["factorizations"] == 1
+    assert st["bytes_resident"] > 0
+    b = np.ones(a.n)
+    x = solve(lu1, b)
+    xd = np.linalg.solve(a.to_scipy().toarray(), b)
+    np.testing.assert_allclose(x, xd, rtol=1e-10)
+
+
+def test_pattern_tier_reuses_plan():
+    a = laplacian_2d(6)
+    cache = FactorCache(backend="host")
+    lu1 = cache.get_or_factorize(a, Options())
+    a2 = _scaled(a, 0.5)
+    lu2 = cache.get_or_factorize(a2, Options())
+    # full-key miss, pattern hit: the symbolic plan object is shared
+    assert lu2 is not lu1
+    assert lu2.plan is lu1.plan
+    st = cache.stats()
+    assert st["pattern_hits"] == 1 and st["factorizations"] == 2
+    # and the refactorized values actually solve the scaled system
+    b = np.ones(a.n)
+    np.testing.assert_allclose(
+        solve(lu2, b), np.linalg.solve(a2.to_scipy().toarray(), b),
+        rtol=1e-10)
+
+
+def test_lru_eviction_by_bytes():
+    mats = [laplacian_2d(5), laplacian_2d(6), laplacian_2d(7)]
+    cache = FactorCache(backend="host")
+    lus = [cache.get_or_factorize(m, Options()) for m in mats]
+    full = cache.stats()["bytes_resident"]
+    assert len(cache) == 3
+    # re-insert under a bound that only fits the last ~two entries
+    per = full // 3
+    cache2 = FactorCache(backend="host", capacity_bytes=2 * per + per // 2)
+    for m in mats:
+        cache2.get_or_factorize(m, Options())
+    st = cache2.stats()
+    assert st["evictions"] >= 1
+    assert st["bytes_resident"] <= 2 * per + per // 2
+    # the hot (most recent) key survived
+    assert cache2.peek(matrix_key(mats[-1], Options())) is not None
+    # the evicted key re-factors (miss), not a stale hit
+    first = matrix_key(mats[0], Options())
+    assert cache2.peek(first, touch=False) is None
+
+
+def test_oversized_single_entry_stays_resident():
+    a = laplacian_2d(6)
+    cache = FactorCache(backend="host", capacity_bytes=1)
+    lu = cache.get_or_factorize(a, Options())
+    assert cache.peek(matrix_key(a, Options())) is lu
+    assert cache.stats()["evictions"] == 0
+
+
+def test_single_flight_concurrent_misses_factor_once():
+    """Two (and eight) threads racing on one cold key must do one
+    factorization's worth of work and share the identical handle."""
+    a = laplacian_3d(6)
+    calls = []
+    call_lock = threading.Lock()
+
+    real = FactorCache(backend="host")._default_factorize
+
+    def counting_factorize(a_, opts_, plan_):
+        with call_lock:
+            calls.append(threading.get_ident())
+        time.sleep(0.05)          # widen the race window
+        return real(a_, opts_, plan_)
+
+    cache = FactorCache(backend="host",
+                        factorize_fn=counting_factorize)
+    results = [None] * 8
+    barrier = threading.Barrier(8)
+
+    def hit(i):
+        barrier.wait()
+        results[i] = cache.get_or_factorize(a, Options())
+
+    threads = [threading.Thread(target=hit, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1, f"{len(calls)} factorizations for one key"
+    assert all(r is results[0] for r in results)
+    st = cache.stats()
+    assert st["single_flight_waits"] == 7
+    assert st["factorizations"] == 1
+
+
+def test_single_flight_follower_deadline():
+    """A follower waiting on another caller's in-flight factorization
+    honors its deadline; the leader runs to completion and the result
+    still lands in the cache."""
+    import time as _time
+    from superlu_dist_tpu.serve import DeadlineExceeded
+    a = laplacian_2d(6)
+    real = FactorCache(backend="host")._default_factorize
+    entered = threading.Event()
+
+    def slow_factorize(a_, opts_, plan_):
+        entered.set()
+        time.sleep(0.3)
+        return real(a_, opts_, plan_)
+
+    cache = FactorCache(backend="host", factorize_fn=slow_factorize)
+    leader = threading.Thread(
+        target=lambda: cache.get_or_factorize(a, Options()),
+        daemon=True)
+    leader.start()
+    assert entered.wait(5)
+    with pytest.raises(DeadlineExceeded, match="in-flight"):
+        cache.get_or_factorize(
+            a, Options(), deadline=_time.monotonic() + 0.05)
+    leader.join()
+    # the leader's work was not wasted
+    assert cache.peek(matrix_key(a, Options())) is not None
+
+
+def test_single_flight_leader_failure_propagates():
+    a = laplacian_2d(5)
+    n_calls = [0]
+
+    def failing_factorize(a_, opts_, plan_):
+        n_calls[0] += 1
+        time.sleep(0.02)
+        raise RuntimeError("boom")
+
+    cache = FactorCache(backend="host", factorize_fn=failing_factorize)
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def hit():
+        barrier.wait()
+        try:
+            cache.get_or_factorize(a, Options())
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=hit) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # every caller saw the failure; only the leader(s) paid for it
+    assert len(errors) == 4
+    assert n_calls[0] <= 2   # leader + at most one re-elected retry
+
+
+def test_gssvx_factored_reuses_operand_cache():
+    """The FACTORED rung hands refinement operands back to the
+    caller's handle: the second gssvx(FACTORED) call must not rebuild
+    the O(nnz) scipy operands (the serve hot path solves through this
+    rung)."""
+    from superlu_dist_tpu import Fact
+    a = laplacian_2d(6)
+    b = np.ones(a.n)
+    x0, lu, _ = gssvx(Options(), a, b, backend="host")
+    assert lu.refine_cache is not None
+    first = lu.refine_cache
+    x1, _, _ = gssvx(Options(fact=Fact.FACTORED), a, b, lu=lu,
+                     backend="host")
+    assert lu.refine_cache is first       # same dict object: no rebuild
+    np.testing.assert_allclose(x1, x0, rtol=1e-12)
