@@ -395,6 +395,8 @@ def _run_config(a, desc, nrhs, jnp):
     from superlu_dist_tpu.plan.plan import plan_factorization
     from superlu_dist_tpu.utils.testmat import manufactured_rhs
 
+    from superlu_dist_tpu import obs
+
     xtrue, b = manufactured_rhs(a, nrhs=nrhs)
     if nrhs > 1:
         desc += f" nrhs={nrhs}"
@@ -434,16 +436,18 @@ def _run_config(a, desc, nrhs, jnp):
     bb = jnp.asarray(b[:, None] if b.ndim == 1 else b)
 
     t0 = time.perf_counter()
-    x, berr, steps, tiny, nzero = step(vals, bb)   # compile + run
-    x.block_until_ready()
+    with obs.span("bench.warmup", cat="bench", args={"n": a.n}):
+        x, berr, steps, tiny, nzero = step(vals, bb)   # compile + run
+        x.block_until_ready()
     t_warm = time.perf_counter() - t0
 
     # steady state (SamePattern production loop: new values, same plan)
     best = np.inf
-    for _ in range(3):
+    for i in range(3):
         t0 = time.perf_counter()
-        x, berr, steps, tiny, nzero = step(vals, bb)
-        x.block_until_ready()
+        with obs.span("bench.step", cat="bench", args={"iter": i}):
+            x, berr, steps, tiny, nzero = step(vals, bb)
+            x.block_until_ready()
         best = min(best, time.perf_counter() - t0)
     x = np.asarray(x)
     x = x[:, 0] if xtrue.ndim == 1 else x
@@ -468,6 +472,21 @@ def _run_config(a, desc, nrhs, jnp):
 
 
 def main():
+    # --trace PATH: export the run's phase spans + compile events as
+    # a Chrome trace-event JSON (Perfetto-loadable) alongside the
+    # BENCH json line — the observability twin of the metric.
+    # Resolved before anything imports the solver so the tracer is on
+    # for the whole pipeline (plan phases included).
+    argv = sys.argv[1:]
+    trace_path = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("bench: --trace requires a path", file=sys.stderr)
+            raise SystemExit(2)
+        trace_path = argv[i + 1]
+        from superlu_dist_tpu import obs
+        obs.configure(enabled=True, trace_path=trace_path)
     if "--serve" in sys.argv[1:]:
         # serve-mode load benchmark (tools/serve_bench.py): factor
         # once, drive concurrent solves through the micro-batching
@@ -609,8 +628,17 @@ def main():
         from superlu_dist_tpu.utils.platform import (
             strip_accel_amalg_defaults)
         env = strip_accel_amalg_defaults(env)
+        # argv rides along so a --trace'd run still writes its trace
+        # from the CPU child
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
+
+    if trace_path is not None:
+        from superlu_dist_tpu import obs
+        obs.export_trace(trace_path)
+        print(f"bench: trace written to {trace_path}",
+              file=sys.stderr)
 
     mfu_txt = ""
     mfu_invalid = False

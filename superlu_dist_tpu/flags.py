@@ -44,6 +44,11 @@ FLAGS: dict[str, str] = {
     "SLU_TPU_PALLAS_SCATTER": "1 = enable the Pallas one-hot MXU scatter engine for ragged extend-add",
     # --- planning / ordering (parallel/ordering_dist.py) ---
     "SLU_DORDER_CLUSTER": "distributed-ordering aggregation block size (default 16)",
+    # --- observability (obs/tracer.py, obs/compile_watch.py) ---
+    "SLU_OBS": "1/0 master observability switch: span tracer + pivot-growth capture (default off unless SLU_TRACE*/SLU_TRACE_JSONL set; off costs one pointer check per span — no gssvx tax, pinned by tests/test_obs_trace.py)",
+    "SLU_TRACE": "Chrome trace-event JSON export path, written at process exit (1 = ./last.trace.json; implies SLU_OBS; ~1 µs + one dict per span while on)",
+    "SLU_TRACE_JSONL": "JSONL event-log path, appended through as spans close (implies SLU_OBS; adds one file write per span)",
+    "SLU_OBS_COST": "1 = XLA cost-analysis FLOP/byte accounting on each jit cache miss -> Stats.ops_measured (re-pays one AOT lower+compile per NEW signature; zero cost on the recompile-free hot path)",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---

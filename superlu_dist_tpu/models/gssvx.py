@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from .. import obs
 from ..options import ColPerm, Fact, IterRefine, Options, Trans
 from ..plan.plan import FactorPlan, plan_factorization
 from ..sparse import CSRMatrix
@@ -118,6 +119,9 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             "base-level complex lowering hangs on this platform "
             "(TPU_SMOKE.jsonl c128_kernel; utils/platform.py). "
             "Use a CPU mesh, or SLU_COMPLEX_TPU=1 to override.")
+    # drop any stale stamp from a direct ops-layer call the driver
+    # never read (the host path below stamps nothing)
+    obs.take_cost("factor")
     with complex_device_gate(np.dtype(options.factor_dtype)), \
             stats.timer(_phase):
         if backend == "host":
@@ -148,6 +152,11 @@ def factorize(a: CSRMatrix, options: Options | None = None,
                 cache[key] = factor_dist.make_dist_factor(
                     plan, mesh, dtype=np.dtype(options.factor_dtype))
             dist_lu = cache[key](scaled)
+            # single-signature closure, so the wrapper's last-miss
+            # cost IS this call's program; same thread-local hand-off
+            # as the batched path
+            obs.stamp_cost("factor",
+                           getattr(cache[key].jitted, "cost", None))
             stats.tiny_pivots += dist_lu.tiny_pivots
             stats.comm_predicted = dist_lu.schedule.comm_summary(
                 np.dtype(options.factor_dtype))
@@ -157,8 +166,24 @@ def factorize(a: CSRMatrix, options: Options | None = None,
             raise ValueError(f"unknown backend {backend!r}")
     lu.options = options
     stats.add_ops(_phase, plan.factor_flops)
+    # XLA cost-analysis flop accounting (SLU_OBS_COST=1): the program
+    # cost the backend stamped for THIS call (thread-local hand-off,
+    # obs/compile_watch.py), accumulated per factorization like
+    # add_ops/utime — so gflops() divides N executions' flops by N
+    # executions' wall, and a warm-cache refactorization never adopts
+    # another schedule's program
+    stats.set_measured_cost(_phase, obs.take_cost("factor"))
     stats.lu_nnz = plan.lu_nnz()
     stats.lu_bytes = stats.lu_nnz * np.dtype(options.factor_dtype).itemsize
+    # numerical-health watch (obs/health.py): GESP never pivots at
+    # runtime, so every factorization reports its tiny-pivot
+    # replacements — and, when tracing is on (the estimate walks
+    # diag(U) to the host), a pivot-growth estimate
+    src = lu.host_lu if lu.backend == "host" else lu.device_lu
+    obs.HEALTH.record_factor(
+        tiny_pivots=int(getattr(src, "tiny_pivots", 0)),
+        pivot_growth=(obs.pivot_growth(lu) if obs.enabled() else None),
+        dtype=options.factor_dtype)
     return lu
 
 
@@ -246,8 +271,10 @@ def solve(lu: LUFactorization, b: np.ndarray,
     from ..utils.platform import complex_device_gate
     factor_dt = np.dtype(lu.effective_options.factor_dtype)
     with complex_device_gate(factor_dt, bb.dtype):
+        obs.take_cost("solve")  # drop any stale unread stamp
         with stats.timer("SOLVE"):
             x = from_factor_sol(solver(lu, to_factor_rhs(bb)))
+        stats.set_measured_cost("SOLVE", obs.take_cost("solve"))
 
         if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
             from .refine import iterative_refine
@@ -301,36 +328,56 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
         return out
     sched = lu.device_lu.schedule
 
-    def _np_decode(flat):
-        # pair-stored factors ((2, N) real planes) decode to complex
-        # on the host for this numpy walk
-        flat = np.asarray(flat)
-        return flat[0] + 1j * flat[1] if flat.ndim == 2 else flat
+    def _gather_decode(flat, idx):
+        # device-side gather of just the diagonal entries: only O(n)
+        # scalars cross to the host, never the full U slab (the
+        # tracing-gated health.pivot_growth hook calls this per
+        # factorization, so the slab transfer would be real money).
+        # Pair-stored factors ((2, N) real planes) decode to complex
+        # after the gather.
+        import jax.numpy as jnp
+        flat = jnp.asarray(flat)
+        if flat.ndim == 2:
+            picked = np.asarray(jnp.take(flat, idx, axis=1))
+            return picked[0] + 1j * picked[1]
+        return np.asarray(jnp.take(flat, idx))
+
+    def _diag_idx(groups, base_of):
+        # flat indices of diag(U) + their destination columns; a
+        # (wb, mb) row-major panel's diagonal is base + i*(mb+1)
+        idx, dst = [], []
+        for g in groups:
+            for bg, s in zip(g.sup_pos, g.sup_ids):
+                w = int(fp.w[s])
+                base = base_of(g, int(bg))
+                idx.append(base + np.arange(w) * (g.mb + 1))
+                dst.append(int(xsup[s]) + np.arange(w))
+        return (np.concatenate(idx) if idx else np.empty(0, np.int64),
+                np.concatenate(dst) if dst else np.empty(0, np.int64))
 
     panels = getattr(lu.device_lu, "panels", None)
     if panels is not None:
         # staged factors: per-group local U flats, offset 0
+        # (staged is single-device, so bg is the local block index)
         for g, p in zip(sched.groups, panels):
-            Ug = _np_decode(p[1])
-            for bg, s in zip(g.sup_pos, g.sup_ids):
-                b = int(bg)     # staged is single-device (d == 0)
-                panel = Ug[b * g.wb * g.mb:(b + 1) * g.wb
-                           * g.mb].reshape(g.wb, g.mb)
-                w = int(fp.w[s])
-                out[int(xsup[s]):int(xsup[s]) + w] = \
-                    np.diagonal(panel)[:w]
+            idx, dst = _diag_idx([g], lambda g, b: b * g.wb * g.mb)
+            if idx.size:
+                out[dst] = _gather_decode(p[1], idx)
         return out
-    U_flat = _np_decode(lu.device_lu.U_flat)
+    U_flat = lu.device_lu.U_flat
     # dist flats are the ndev-concatenated device-major slabs; the
     # single-device case is ndev=1 of the same layout
-    U_total = U_flat.size // sched.ndev
-    for g in sched.groups:
-        for bg, s in zip(g.sup_pos, g.sup_ids):
-            d, b = divmod(int(bg), g.n_loc)
-            base = d * U_total + g.U_off + b * g.wb * g.mb
-            panel = U_flat[base:base + g.wb * g.mb].reshape(g.wb, g.mb)
-            w = int(fp.w[s])
-            out[int(xsup[s]):int(xsup[s]) + w] = np.diagonal(panel)[:w]
+    n_elems = (U_flat.shape[1] if getattr(U_flat, "ndim", 1) == 2
+               else U_flat.size)
+    U_total = n_elems // sched.ndev
+
+    def _base(g, bg):
+        d, b = divmod(bg, g.n_loc)
+        return d * U_total + g.U_off + b * g.wb * g.mb
+
+    idx, dst = _diag_idx(sched.groups, _base)
+    if idx.size:
+        out[dst] = _gather_decode(U_flat, idx)
     return out
 
 
@@ -370,6 +417,18 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
     ColPerm.MY_PERMC."""
     options = options or Options()
     stats = stats if stats is not None else Stats()
+    # this run's phase stats become the registry's "stats" surface
+    # (last-solve-wins — the PStatPrint cardinality); the root span
+    # makes every numeric-phase span a CHILD in the exported trace
+    obs.REGISTRY.register("stats", stats)
+    with obs.span("gssvx", cat="driver",
+                  args={"n": a.n, "fact": options.fact.name}):
+        return _gssvx_impl(options, a, b, stats, backend, lu,
+                           user_perm_r, user_perm_c, grid)
+
+
+def _gssvx_impl(options, a, b, stats, backend, lu,
+                user_perm_r, user_perm_c, grid):
     if options.fact in (Fact.FACTORED, Fact.SAME_PATTERN,
                         Fact.SAME_PATTERN_SAME_ROWPERM) and lu is None:
         raise ValueError(f"options.fact={options.fact.name} requires "
@@ -429,6 +488,10 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
         # because GESP has no mid-factor pivoting to fall back on.
         # The plan is value-identical, so it is reused outright.
         stats.escalations += 1
+        obs.HEALTH.record_escalation(
+            berr=stats.berr,
+            factor_dtype=lu.effective_options.factor_dtype,
+            refine_dtype=options.refine_dtype)
         opts2 = options.replace(factor_dtype=options.refine_dtype)
         # the rerun reports under FACT_ESC so FACT's GFLOP/s never
         # blends two differently-precisioned factorizations

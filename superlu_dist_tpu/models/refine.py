@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import obs
+
 
 def _refine_dtype(opts, a_dtype):
     """SLU_SINGLE accumulates residuals in the working (factor)
@@ -94,17 +96,45 @@ def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
     r = bk - asp @ xk
     berr = berr_of(r, xk)
     steps = 0
+    # health trajectories (obs/health.py): the berr path of the loop
+    # and the forward-error proxy ‖δ‖/‖x‖ per step — the runtime
+    # numerics watch the GESP contract demands (a drifting value set
+    # against cached factors shows up HERE first)
+    berr_traj = [berr]
+    ferr_traj = []
+    track_ferr = obs.enabled()
+    stalled = False
     for _ in range(opts.max_refine_steps):
         if berr <= eps:
             break
-        d = from_factor_sol(solve_factored(lu, to_factor_rhs(r)))
-        x_new = xk + d
-        r_new = bk - asp @ x_new
-        berr_new = berr_of(r_new, x_new)
+        with obs.span("REFINE_STEP", args={"berr": berr}):
+            d = from_factor_sol(solve_factored(lu, to_factor_rhs(r)))
+            x_new = xk + d
+            r_new = bk - asp @ x_new
+            berr_new = berr_of(r_new, x_new)
         steps += 1
+        berr_traj.append(berr_new)
+        if track_ferr:
+            # two full-array host norms — only worth paying when
+            # observability is on (berr above is free: the loop's own
+            # control variable)
+            xn = float(np.linalg.norm(x_new))
+            ferr_traj.append(
+                float(np.linalg.norm(d)) / xn if xn else 0.0)
         if not np.isfinite(berr_new) or berr_new >= berr * 0.5:
+            stalled = True
             if berr_new < berr:
                 xk, berr = x_new, berr_new
             break
         xk, r, berr = x_new, r_new, berr_new
+    # the numerics alarm is "berr stopped halving SHORT of eps" —
+    # neither a loop that ran out of step budget while still
+    # improving, nor one whose last halving landed at machine
+    # precision (berr can't halve below eps), is a stall
+    converged = bool(berr <= eps)
+    obs.HEALTH.record_refine(berr=berr, steps=steps,
+                             berr_trajectory=berr_traj,
+                             ferr_trajectory=ferr_traj,
+                             converged=converged,
+                             stalled=stalled and not converged)
     return xk, berr, steps

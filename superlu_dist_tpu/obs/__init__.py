@@ -1,0 +1,69 @@
+"""obs/ — the unified observability spine.
+
+One place answers the three runtime questions the PStatPrint report
+(SRC/util.c:331) answers offline and a multi-tenant service must
+answer live:
+
+  * where did this solve's time go? — `tracer`: thread-safe nested
+    phase spans (equilibrate → rowperm → colperm → symbolic →
+    distribute → factor → solve → refine, plus the serve
+    queue/assemble/batch/solve stages), exported as Chrome
+    trace-event JSON (Perfetto-loadable; `tools/trace_export.py`)
+    and/or a JSONL event log.  Gated by SLU_OBS / SLU_TRACE /
+    SLU_TRACE_JSONL with a no-op singleton fast path when off.
+  * did XLA recompile? — `compile_watch`: per-jitted-phase cache-miss
+    counters with shape/dtype/static-arg attribution, and optional
+    XLA cost-analysis FLOP/byte accounting (SLU_OBS_COST=1) that
+    feeds `Stats.ops_measured`.
+  * are the numerics drifting? — `health`: tiny-pivot replacement
+    counts, pivot-growth estimates, berr/ferr trajectories and
+    escalation events — the GESP runtime-watch obligation.
+
+Everything registers into ONE `Registry` (`REGISTRY`): per-run phase
+stats (utils/stats.py), serve metrics (serve/metrics.py), the compile
+watcher, the health monitor and the tracer, so `obs.snapshot()` is
+the single structured view and `obs.dump_text()` the single
+Prometheus-style text dump (wired into `SolveService` and
+`bench.py --serve`).
+"""
+
+from .compile_watch import (COMPILE_WATCH, CompileWatch, stamp_cost,
+                            take_cost, watch_jit)
+from .health import HEALTH, HealthMonitor, pivot_growth
+from .registry import REGISTRY, Registry
+from .tracer import (NULL_SPAN, Tracer, complete, configure, enabled,
+                     export_trace, get_tracer, instant,
+                     resolve_trace_path, span)
+
+__all__ = [
+    "COMPILE_WATCH", "CompileWatch", "HEALTH", "HealthMonitor",
+    "NULL_SPAN", "REGISTRY", "Registry", "Tracer", "complete",
+    "configure", "dump_text", "enabled", "export_trace", "get_tracer",
+    "instant", "pivot_growth", "resolve_trace_path", "snapshot",
+    "span", "stamp_cost", "take_cost", "watch_jit",
+]
+
+
+class _TracerProvider:
+    """Registry shim: snapshots whichever tracer is currently live
+    (the tracer object itself is swapped by configure())."""
+
+    @staticmethod
+    def snapshot() -> dict:
+        t = get_tracer()
+        return t.snapshot() if t is not None else {"enabled": False}
+
+
+REGISTRY.register("compile", COMPILE_WATCH)
+REGISTRY.register("health", HEALTH)
+REGISTRY.register("trace", _TracerProvider())
+
+
+def snapshot() -> dict:
+    """One dict over every registered telemetry surface."""
+    return REGISTRY.snapshot()
+
+
+def dump_text() -> str:
+    """One flat Prometheus-style text dump of the same."""
+    return REGISTRY.dump_text()

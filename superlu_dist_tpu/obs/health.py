@@ -1,0 +1,152 @@
+"""Numerical-health monitors for the GESP runtime contract.
+
+Static pivoting means NOBODY pivots at runtime: a drifting value set
+served through a cached factorization can only be caught by
+*watching* the runtime numerics — tiny-pivot replacement counts,
+pivot-growth estimates, the berr/ferr trajectory of every refinement
+loop, and precision-escalation events (the psgssvx_d2 safety net
+firing).  The reference surfaces the first of these once per
+factorization in PStatPrint (RefineSteps/Berr, SRC/util.c:331); a
+multi-tenant service needs them as a monitored time series, which is
+what this module provides (a Registry provider; the serve layer's
+berr histogram in serve/metrics.py is the percentile view of the same
+signal).
+
+Recording is always on: each hook is one lock plus a few scalar
+writes per solve (noise against a device dispatch), so the monitors
+work regardless of SLU_OBS.  Only the optional pivot-growth estimate
+is gated behind the tracer being enabled — it walks diag(U) to the
+host (O(n) + a device transfer), which is real money on the solve hot
+path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from . import tracer as _tracer
+
+
+class HealthMonitor:
+    """Aggregated numerical-health counters + a bounded ring of
+    per-solve records (a Registry provider)."""
+
+    def __init__(self, recent_cap: int = 64) -> None:
+        self._lock = threading.Lock()
+        self.factorizations = 0
+        self.solves = 0
+        self.tiny_pivots_total = 0
+        self.escalations = 0
+        self.refine_steps_total = 0
+        self.stalled_refines = 0        # loops that quit on stall
+        self.last_berr = 0.0
+        self.last_pivot_growth = 0.0
+        self._recent = collections.deque(maxlen=recent_cap)
+
+    # -- recording hooks ----------------------------------------------
+
+    def record_factor(self, *, tiny_pivots: int = 0,
+                      pivot_growth: float | None = None,
+                      dtype: str = "") -> None:
+        with self._lock:
+            self.factorizations += 1
+            self.tiny_pivots_total += int(tiny_pivots)
+            if pivot_growth is not None:
+                self.last_pivot_growth = float(pivot_growth)
+        if tiny_pivots:
+            _tracer.instant("health.tiny_pivots", cat="health",
+                            args={"count": int(tiny_pivots),
+                                  "dtype": dtype})
+
+    def record_refine(self, *, berr: float, steps: int,
+                      berr_trajectory=(), ferr_trajectory=(),
+                      converged: bool = True,
+                      stalled: bool = False) -> None:
+        """One refinement loop's outcome.  `ferr_trajectory` is the
+        per-step forward-error estimate ‖δ‖/‖x‖ (the correction-norm
+        proxy for pdgsrfs' FERR output).  `stalled` means the loop
+        quit because berr stopped halving — NOT that it merely ran
+        out of step budget while still improving; only the former
+        raises the alarm event."""
+        with self._lock:
+            self.solves += 1
+            self.refine_steps_total += int(steps)
+            self.last_berr = float(berr)
+            if stalled:
+                self.stalled_refines += 1
+            self._recent.append({
+                "berr": float(berr), "steps": int(steps),
+                "berr_trajectory": [float(b) for b in berr_trajectory],
+                "ferr_trajectory": [float(f) for f in ferr_trajectory],
+                "converged": bool(converged),
+                "stalled": bool(stalled),
+            })
+        if stalled:
+            _tracer.instant("health.refine_stalled", cat="health",
+                            args={"berr": float(berr),
+                                  "steps": int(steps)})
+
+    def record_escalation(self, *, berr: float, factor_dtype: str,
+                          refine_dtype: str) -> None:
+        """The low-precision factor failed its refinement contract and
+        gssvx is re-factoring at refine precision — the loudest health
+        event there is."""
+        with self._lock:
+            self.escalations += 1
+        _tracer.instant("health.escalation", cat="health",
+                        args={"berr": float(berr),
+                              "factor_dtype": factor_dtype,
+                              "refine_dtype": refine_dtype})
+
+    # -- readers -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            last = self._recent[-1] if self._recent else None
+            return {
+                "factorizations": self.factorizations,
+                "solves": self.solves,
+                "tiny_pivots_total": self.tiny_pivots_total,
+                "escalations": self.escalations,
+                "refine_steps_total": self.refine_steps_total,
+                "stalled_refines": self.stalled_refines,
+                "last_berr": self.last_berr,
+                "last_pivot_growth": self.last_pivot_growth,
+                "last_solve": dict(last) if last else None,
+            }
+
+    def summary(self) -> str:
+        """One line for Stats.report()."""
+        with self._lock:
+            s = (f"berr {self.last_berr:.2e}, "
+                 f"tiny pivots {self.tiny_pivots_total}, "
+                 f"escalations {self.escalations}, "
+                 f"stalled refines {self.stalled_refines}")
+            if self.last_pivot_growth:
+                s += f", pivot growth {self.last_pivot_growth:.2e}"
+            return s
+
+
+def pivot_growth(lu) -> float | None:
+    """Cheap pivot-growth estimate for a GESP factorization:
+    max|diag(U)| / max|A_scaled| (diag-only — a lower bound on the
+    classic max|U|/max|A|, but free of any full-factor transfer).
+    A large value flags the amplification static pivoting cannot
+    bound; compare against 1/eps of the factor dtype.  Returns None
+    instead of raising when the factors can't be probed (e.g. a
+    mesh-sharded U spanning non-addressable devices) — this runs on
+    the factorize path, and observability never throws into it."""
+    try:
+        from ..models.gssvx import get_diag_u
+        du = np.abs(np.asarray(get_diag_u(lu)))
+        anorm = float(getattr(lu.plan, "anorm", 0.0)) or 1.0
+        return float(du.max() / anorm) if du.size else 0.0
+    except Exception:
+        return None
+
+
+# the process-wide monitor every numeric path reports into
+HEALTH = HealthMonitor()

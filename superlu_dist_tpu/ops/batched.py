@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..plan.plan import FactorPlan
 from .dense_lu import partial_lu_batch, unit_lower_inverse, upper_inverse
 
@@ -1919,7 +1920,14 @@ def _phase_fns(sched, dtype, thresh_np, pair=None):
             return _solve_loop(sched, (L, U, Li, Ui), b, dtype, pairs,
                                None, trans=trans, pair=pair)
 
-        cache[key] = (factor_fn, solve_fn)
+        # compile telemetry (obs/compile_watch.py): each whole-phase
+        # program reports its jit cache misses with shape/dtype
+        # attribution — the recompile counter serve_bench pins its
+        # zero-recompiles-after-warmup contract on.  The proxies
+        # delegate lower()/_cache_size() to the jits underneath.
+        cache[key] = (
+            obs.watch_jit("factor", factor_fn, cost_phase="FACT"),
+            obs.watch_jit("solve", solve_fn, cost_phase="SOLVE"))
         return cache[key]
 
 
@@ -1941,13 +1949,18 @@ def factorize_device(plan: FactorPlan, scaled_vals: np.ndarray,
                                   _thresh_for(plan, dtype), pair=pair)
         vin = (_pair_encode_vals(scaled_vals, dtype) if pair
                else scaled_vals.astype(dtype))
+        vj = jnp.asarray(vin)
         (L_flat, U_flat, Li_flat, Ui_flat, tiny,
-         nzero) = factor_fn(jnp.asarray(vin))
+         nzero) = factor_fn(vj)
         nzero = int(nzero)
         lu = DeviceLU(plan=plan, schedule=sched, dtype=dtype,
                       L_flat=L_flat, U_flat=U_flat,
                       Li_flat=Li_flat, Ui_flat=Ui_flat,
                       tiny_pivots=int(tiny))
+        # THIS call's program cost (SLU_OBS_COST=1), handed to the
+        # Stats consumer via the thread-local slot — NOT the handle,
+        # which the serve layer shares across threads
+        obs.stamp_cost("factor", factor_fn.cost_of(vj))
     if nzero > 0:
         # reference semantics: U(i,i) == 0 with ReplaceTinyPivot=NO is
         # the info=i singularity signal (SRC/pdgstrf.c header); the
@@ -1979,8 +1992,18 @@ def _solve_device_common(lu, b: np.ndarray, trans: bool):
         _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
                                  _thresh_for(lu.plan, lu.dtype),
                                  pair=pair)
+        bj = jnp.asarray(bin_)
         X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
-                     jnp.asarray(bin_), trans=trans)
+                     bj, trans=trans)
+        # the EXECUTED signature's program cost — the solve wrapper
+        # serves the whole nrhs bucket ladder, so a shared last-miss
+        # field would misattribute (a 1-wide solve adopting the
+        # 64-wide program's flops); thread-local, not on the handle,
+        # so concurrent solves through one cached factorization don't
+        # cross-attribute either
+        obs.stamp_cost("solve", solve_fn.cost_of(
+            lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat, bj,
+            trans=trans))
     out = np.asarray(X)
     if pair:
         out = _pair_decode_sol(out, xdt)
@@ -2460,7 +2483,8 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             return step_body(_scale_impl(vals), resid_berr, b_r,
                              per_group_const)
 
-        step = _wrap_pair(step)
+        step = _wrap_pair(obs.watch_jit("fused_step", step,
+                                        cost_phase="FUSED"))
         step.resid_fn = _resid_fn
         step.spmv_layout = layout
         return step
@@ -2506,8 +2530,10 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
             out_specs=(P(), P(), P(), P(), P()),
             check_vma=False)
 
-        jitted_c = jax.jit(
-            lambda vals, b: mapped_c(vals, b, *idx_args))
+        jitted_c = obs.watch_jit(
+            "fused_step_mesh",
+            jax.jit(lambda vals, b: mapped_c(vals, b, *idx_args)),
+            cost_phase="FUSED")
 
         def step_c(vals, b):
             return jitted_c(vals, b)
@@ -2610,9 +2636,11 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         out_specs=(P(), P(), P(), P(), P()),
         check_vma=False)
 
-    jitted = jax.jit(
-        lambda vsel, ssel, vchunk, rc, cc, b: mapped(
-            vsel, ssel, vchunk, rc, cc, b, *idx_args))
+    jitted = obs.watch_jit(
+        "fused_step_mesh",
+        jax.jit(lambda vsel, ssel, vchunk, rc, cc, b: mapped(
+            vsel, ssel, vchunk, rc, cc, b, *idx_args)),
+        cost_phase="FUSED")
 
     def step(vals, b):
         # host-side one-time redistribution per call (dReDistribute_A

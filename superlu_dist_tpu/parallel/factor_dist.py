@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import obs
 from ..plan.plan import FactorPlan
 from ..utils.compat import shard_map as _shard_map
 from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl, _dec,
@@ -312,7 +313,10 @@ def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         body, mesh=mesh, in_specs=(vspec, P()) + idx_specs,
         out_specs=P(), check_vma=False)
 
-    jitted = jax.jit(lambda vsel, b: mapped(vsel, b, *idx_args))
+    jitted = obs.watch_jit(
+        "dist_step",
+        jax.jit(lambda vsel, b: mapped(vsel, b, *idx_args)),
+        cost_phase="FUSED")
     vshard = jax.sharding.NamedSharding(mesh, P(axis))
 
     def step(vals, b):
@@ -383,7 +387,9 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         body, mesh=mesh, in_specs=(vspec,) + idx_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
-    jitted = jax.jit(lambda vsel: mapped(vsel, *idx_args))
+    jitted = obs.watch_jit(
+        "dist_factor", jax.jit(lambda vsel: mapped(vsel, *idx_args)),
+        cost_phase="FACT")
     vshard = jax.sharding.NamedSharding(mesh, P(axis))
 
     def factor(vals) -> DistLU:
@@ -431,7 +437,7 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
         return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
 
-    return solve
+    return obs.watch_jit("dist_solve", solve, cost_phase="SOLVE")
 
 
 def make_dist_solve_rhs_sharded(plan: FactorPlan, mesh: Mesh,
@@ -519,7 +525,8 @@ def make_dist_solve_rhs_sharded(plan: FactorPlan, mesh: Mesh,
         _hi_prec(body), mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(None, axis)),
         out_specs=P(None, axis), check_vma=False)
-    jitted = jax.jit(mapped)
+    jitted = obs.watch_jit("dist_solve_rhs_sharded", jax.jit(mapped),
+                           cost_phase="SOLVE")
 
     def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
         r = b.shape[1]

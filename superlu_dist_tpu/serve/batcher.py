@@ -30,6 +30,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from .. import obs
 from ..models.gssvx import LUFactorization, solve, solve_rhs_dtype
 from .errors import DeadlineExceeded, ServeError
 from .metrics import Metrics
@@ -193,21 +194,30 @@ class MicroBatcher:
                     "deadline passed while queued"))
                 continue
             self.metrics.observe("serve.queue_wait_s", now - r.t_submit)
+            # retrospective trace span: the wait started at submit
+            # time on the caller's thread; the event lands on the
+            # flusher's tid ending now
+            obs.complete("serve.queue", now - r.t_submit, cat="serve")
             live.append(r)
         if not live:
             return
         t0 = time.monotonic()
         k = bucket_for(len(live), self.ladder)
-        B = np.zeros((self.lu.n, k), dtype=self.dtype)
-        for j, r in enumerate(live):
-            B[:, j] = r.b
+        with obs.span("serve.assemble", cat="serve",
+                      args={"batch": len(live), "nrhs": k}):
+            B = np.zeros((self.lu.n, k), dtype=self.dtype)
+            for j, r in enumerate(live):
+                B[:, j] = r.b
         self.metrics.observe("serve.batch_assembly_s",
                              time.monotonic() - t0)
         self.metrics.observe("serve.batch_occupancy", len(live) / k)
         self.metrics.inc("batcher.requests_solved", len(live))
         t1 = time.monotonic()
         try:
-            X = self._solve_fn(self.lu, B)
+            with obs.span("serve.batch_solve", cat="serve",
+                          args={"nrhs": k,
+                                "occupancy": len(live) / k}):
+                X = self._solve_fn(self.lu, B)
         except BaseException as e:
             for r in live:
                 r.future.set_exception(e)

@@ -13,6 +13,13 @@ tests and `tools/serve_bench.py` drive report true p50/p95/p99 while
 memory stays bounded under sustained traffic.  `Metrics.snapshot()`
 returns a plain-JSON dict — one line of which becomes the
 `SERVE_LATENCY.jsonl` record.
+
+A Metrics instance is also an `obs.Registry` provider (it has exactly
+the snapshot() contract): `register_obs()` places it in the unified
+observability registry, where `obs.snapshot()["serve"]` and the
+Prometheus-style `obs.dump_text()` expose the serve counters next to
+the phase stats, compile misses and health monitors.  SolveService
+does this automatically.
 """
 
 from __future__ import annotations
@@ -141,3 +148,16 @@ class Metrics:
                 "histograms": {k: h.summary()
                                for k, h in sorted(self._histograms.items())},
             }
+
+    def register_obs(self, name: str = "serve") -> "Metrics":
+        """Register this instance in the unified observability
+        registry (last-wins per name)."""
+        from .. import obs
+        obs.REGISTRY.register(name, self)
+        return self
+
+    def unregister_obs(self, name: str = "serve") -> None:
+        """Compare-and-remove: only drops the registration if this
+        instance still owns it."""
+        from .. import obs
+        obs.REGISTRY.unregister(name, self)

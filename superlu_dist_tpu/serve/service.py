@@ -107,6 +107,10 @@ class SolveService:
             raise ValueError(
                 f"unknown miss_policy {self.config.miss_policy!r}")
         self.metrics = metrics or Metrics()
+        # the service's metrics ARE the registry's "serve" surface:
+        # obs.snapshot() / obs.dump_text() expose them next to phase
+        # stats, compile misses and the health monitors
+        self.metrics.register_obs("serve")
         # `is not None`, not truthiness: an EMPTY FactorCache has
         # len()==0 and would be silently replaced
         self.cache = cache if cache is not None else FactorCache(
@@ -159,6 +163,18 @@ class SolveService:
             self._batchers.clear()
         for b in batchers:
             b.close()
+        self.metrics.unregister_obs("serve")
+
+    def obs_snapshot(self) -> dict:
+        """The unified observability snapshot (obs.Registry): serve
+        metrics + phase stats + compile misses + health monitors."""
+        from .. import obs
+        return obs.snapshot()
+
+    def dump_metrics_text(self) -> str:
+        """Flat Prometheus-style text dump of the same registry."""
+        from .. import obs
+        return obs.dump_text()
 
     # -- request path --------------------------------------------------
 

@@ -13,6 +13,8 @@ import dataclasses
 import time
 from typing import Dict
 
+from .. import obs
+
 
 # Phase keys mirroring PhaseType (SRC/superlu_enum_consts.h:66-90).
 # FACT_ESC is this build's addition: the precision-escalation rerun
@@ -79,6 +81,13 @@ class Stats:
         default_factory=lambda: {p: 0.0 for p in PHASES})
     ops: Dict[str, float] = dataclasses.field(
         default_factory=lambda: {p: 0.0 for p in PHASES})
+    # XLA cost-analysis flop counts per phase (obs/compile_watch.py,
+    # SLU_OBS_COST=1): the compiled program's own accounting, preferred
+    # over the hand-counted `ops` when present
+    ops_measured: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    bytes_measured: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
     tiny_pivots: int = 0
     refine_steps: int = 0
     berr: float = 0.0
@@ -99,9 +108,14 @@ class Stats:
 
     @contextlib.contextmanager
     def timer(self, phase: str):
+        # every phase wall doubles as an obs trace span (the Chrome
+        # trace and the report come from the SAME brackets, so they
+        # cannot disagree); obs.span is a shared no-op when tracing
+        # is off
         t0 = time.perf_counter()
         try:
-            yield
+            with obs.span(phase, cat="phase"):
+                yield
         finally:
             self.utime[phase] = self.utime.get(phase, 0.0) + (
                 time.perf_counter() - t0)
@@ -109,9 +123,45 @@ class Stats:
     def add_ops(self, phase: str, flops: float) -> None:
         self.ops[phase] = self.ops.get(phase, 0.0) + flops
 
+    def set_measured_cost(self, phase: str, cost: dict | None) -> None:
+        """Adopt an XLA cost-analysis record ({flops, bytes}) for ONE
+        execution of a phase program (obs/compile_watch.py under
+        SLU_OBS_COST=1).  Accumulates like add_ops/utime, so N
+        factorizations' measured flops divide by N factorizations'
+        wall in gflops()."""
+        if not cost:
+            return
+        if cost.get("flops"):
+            self.ops_measured[phase] = self.ops_measured.get(
+                phase, 0.0) + float(cost["flops"])
+        if cost.get("bytes"):
+            self.bytes_measured[phase] = self.bytes_measured.get(
+                phase, 0.0) + float(cost["bytes"])
+
     def gflops(self, phase: str) -> float:
         t = self.utime.get(phase, 0.0)
-        return (self.ops.get(phase, 0.0) / t / 1e9) if t > 0 else 0.0
+        if t <= 0:
+            return 0.0
+        flops = self.ops_measured.get(phase) \
+            or self.ops.get(phase, 0.0)
+        return flops / t / 1e9
+
+    def snapshot(self) -> dict:
+        """JSON-ready view for the obs.Registry (the serve
+        Metrics.snapshot analog for per-run phase stats)."""
+        return {
+            "utime": {p: t for p, t in self.utime.items() if t},
+            "ops": {p: v for p, v in self.ops.items() if v},
+            "ops_measured": dict(self.ops_measured),
+            "bytes_measured": dict(self.bytes_measured),
+            "tiny_pivots": self.tiny_pivots,
+            "refine_steps": self.refine_steps,
+            "berr": self.berr,
+            "escalations": self.escalations,
+            "lu_nnz": self.lu_nnz,
+            "lu_bytes": self.lu_bytes,
+            "comm_predicted": dict(self.comm_predicted),
+        }
 
     def report(self) -> str:
         """PStatPrint-style report (SRC/util.c:331)."""
@@ -126,6 +176,21 @@ class Stats:
             lines.append(line)
         lines.append(f"  tiny pivots replaced: {self.tiny_pivots}")
         lines.append(f"  refinement steps:     {self.refine_steps}")
+        # process-wide compile + health telemetry (obs/): the jit
+        # caches and the health monitor are process-scoped like the
+        # compile caches themselves, so the report shows the process
+        # counters alongside this run's walls
+        cw = obs.COMPILE_WATCH.snapshot()
+        by = ", ".join(f"{k}={v}" for k, v in
+                       sorted(cw["by_phase"].items()))
+        lines.append(f"  jit compiles:         {cw['misses']} miss"
+                     + (f" ({by})" if by else ""))
+        if self.ops_measured:
+            meas = ", ".join(
+                f"{p}={v / 1e9:.2f}e9" for p, v in
+                sorted(self.ops_measured.items()))
+            lines.append(f"  measured flops (XLA): {meas}")
+        lines.append(f"  health: {obs.HEALTH.summary()}")
         if self.escalations:
             lines.append(
                 f"  precision escalations: {self.escalations}")

@@ -49,9 +49,17 @@ def test_phase_walls_and_report_pinned():
     rep = stats.report()
     for key in ("** Phase breakdown **", "FACT", "SOLVE", "REFINE",
                 "GF/s", "tiny pivots replaced", "refinement steps",
-                "nnz(L+U)"):
+                "nnz(L+U)",
+                # the obs/ extension of the pinned contract: compile
+                # counters and the numerical-health summary ride in
+                # the same report (PR 4)
+                "jit compiles:", "health: berr"):
         assert key in rep, key
     assert stats.gflops("FACT") > 0.0
+    # the report's snapshot twin feeds the obs.Registry
+    snap = stats.snapshot()
+    assert snap["utime"]["FACT"] > 0.0
+    assert snap["refine_steps"] == stats.refine_steps
 
 
 def test_measured_comm_matches_prediction():

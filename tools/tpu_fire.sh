@@ -45,9 +45,15 @@ stamp "fire start (dryrun=${SLU_FIRE_DRYRUN:-0})"
 #    would truncate the committed hardware evidence BEFORE bench runs,
 #    so a tunnel that died between probe and bench would replace the
 #    prior TPU measurement with a CPU-fallback line.
+#    The --trace twin (Chrome trace-event JSON, Perfetto-loadable)
+#    archives with the round's artifacts next to the BENCH json: the
+#    same run's phase spans + compile events are the round's
+#    where-did-the-wall-go evidence.
+bench_trace=${bench_out%.json}.trace.json
 bench_tmp=$(mktemp)
+trace_tmp=$(mktemp -u).trace.json
 SLU_BENCH_ASSUME_LIVE=1 timeout 1500 python "$repo/bench.py" \
-  > "$bench_tmp" 2>> "$log"
+  --trace "$trace_tmp" > "$bench_tmp" 2>> "$log"
 rc=$?
 cat "$bench_tmp" >> "$log"
 if grep -q '"cpu_fallback": false' "$bench_tmp" \
@@ -63,11 +69,18 @@ if grep -q '"cpu_fallback": false' "$bench_tmp" \
      || ! grep -q '"hw_record_saved": true' "$bench_tmp"; then
     mv "$bench_tmp" "$bench_out"
   fi
-  stamp "bench primary rc=$rc -> $bench_out"
+  # the trace promotes under the SAME gate: a fallback run's spans
+  # next to a prior round's TPU bench JSON would be mismatched
+  # evidence
+  if [ -f "$trace_tmp" ]; then
+    mv "$trace_tmp" "$bench_trace"
+    stamp "trace archived -> $bench_trace"
+  fi
+  stamp "bench primary rc=$rc -> $bench_out (trace: $bench_trace)"
 else
   stamp "bench primary rc=$rc fell back/failed; kept prior $bench_out"
 fi
-rm -f "$bench_tmp"
+rm -f "$bench_tmp" "$trace_tmp"
 
 # 2. One profiled step of the warm fused solver -> committed op-level
 #    summary (TPU_PROFILE_r05.json; raw trace stays in gitignored

@@ -203,10 +203,31 @@ def main():
     with open(tmp, "w") as f:
         json.dump(rec, f, indent=1)
     os.replace(tmp, OUT)
+    # twin artifact in the UNIFIED trace format (obs/ tracer schema):
+    # the fusion-class buckets and top ops as Chrome trace spans, so
+    # the profiled step opens in Perfetto next to the solver's own
+    # SLU_TRACE phase spans instead of living in a bespoke JSON only
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    trace_out = (OUT[:-5] if OUT.endswith(".json") else OUT) \
+        + ".trace.json"
+    trace_err = None
+    try:
+        from trace_export import chrome_trace_from_profile, write_chrome
+        write_chrome(chrome_trace_from_profile(rec), trace_out,
+                     other={"source": os.path.basename(OUT),
+                            "device": rec.get("device", "")})
+    except Exception as e:
+        # the twin is auxiliary: the profile JSON above is already
+        # promoted, so a trace-conversion failure is reported in-band
+        # instead of failing the fire step's profile stage
+        trace_out, trace_err = None, repr(e)
     dev_planes = [p["plane"] for p in rec["planes"]]
-    print(json.dumps(dict(profile=OUT, wall_s=meta[
+    line = dict(profile=OUT, trace=trace_out, wall_s=meta[
         "profiled_step_wall_s"], planes=dev_planes,
-        scatter_gather_ms=rec["scatter_gather_ms"])))
+        scatter_gather_ms=rec["scatter_gather_ms"])
+    if trace_err:
+        line["trace_error"] = trace_err
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

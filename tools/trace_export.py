@@ -1,0 +1,174 @@
+"""Chrome trace-event export / validation CLI for obs traces.
+
+The span tracer (superlu_dist_tpu/obs/tracer.py) emits events in the
+Chrome trace-event format — the schema Perfetto (ui.perfetto.dev) and
+chrome://tracing load natively.  This tool validates, summarizes and
+converts those artifacts:
+
+    python -m tools.trace_export last.trace.json
+        validate the Chrome trace JSON + print a per-span summary
+
+    python -m tools.trace_export events.jsonl -o last.trace.json
+        convert a JSONL event log (SLU_TRACE_JSONL) into a
+        Perfetto-loadable Chrome trace JSON
+
+It is also the shared converter tools/tpu_profile.py uses to emit its
+fusion-class buckets as spans in the same trace format
+(`chrome_trace_from_profile`), so the profiled-step breakdown and the
+solver's own phase spans open in the same viewer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# keys every trace event must carry; "X" (complete) events add "dur".
+REQUIRED_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+
+def validate_events(events) -> None:
+    """Raise ValueError on the first schema violation (the pinned
+    ph/ts/dur/pid/tid contract of tests/test_obs_trace.py)."""
+    if not isinstance(events, list):
+        raise ValueError("traceEvents must be a list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i} is not an object")
+        if ev.get("ph") == "M":
+            continue                    # metadata events: name/pid only
+        for k in REQUIRED_KEYS:
+            if k not in ev:
+                raise ValueError(f"event {i} missing key {k!r}: {ev}")
+        if not isinstance(ev["ts"], (int, float)):
+            raise ValueError(f"event {i} ts not numeric")
+        if ev["ph"] == "X":
+            if "dur" not in ev or not isinstance(
+                    ev["dur"], (int, float)) or ev["dur"] < 0:
+                raise ValueError(
+                    f"event {i} 'X' without a valid dur: {ev}")
+
+
+def load(path: str) -> list:
+    """Events from a Chrome trace JSON ({"traceEvents": [...]} or a
+    bare array) or a JSONL event log.  Raises ValueError for content
+    that is not a trace (a validator that certifies corrupt or empty
+    artifacts as valid is worse than none)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if path.endswith(".jsonl"):
+            events = [json.loads(line) for line in f if line.strip()]
+            if not events:
+                raise ValueError(f"{path}: empty JSONL event log")
+            return events
+        if head not in ("{", "["):
+            raise ValueError(
+                f"{path}: not a trace JSON "
+                f"({'empty file' if not head else f'starts with {head!r}'})")
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        if "traceEvents" not in doc:
+            raise ValueError(
+                f"{path}: JSON object without a 'traceEvents' key")
+        return doc["traceEvents"]
+    return doc
+
+
+def write_chrome(events: list, path: str, other: dict | None = None) -> str:
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": dict(other or {},
+                             producer="superlu_dist_tpu.obs")}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def summarize(events: list) -> dict:
+    """Per-span-name {count, total_ms}, compile-event count, tids."""
+    by_name: dict[str, dict] = {}
+    compiles = 0
+    tids = set()
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        tids.add(ev.get("tid"))
+        if ev.get("cat") == "compile":
+            compiles += 1
+        if ev.get("ph") != "X":
+            continue
+        rec = by_name.setdefault(ev["name"], {"count": 0,
+                                              "total_ms": 0.0})
+        rec["count"] += 1
+        rec["total_ms"] = round(rec["total_ms"]
+                                + ev.get("dur", 0) / 1e3, 3)
+    return {"events": len(events), "threads": len(tids),
+            "compile_events": compiles, "spans": by_name}
+
+
+def chrome_trace_from_profile(rec: dict) -> list:
+    """tpu_profile.py summary record -> trace events: one synthetic
+    timeline per xplane plane, fusion-class buckets laid end-to-end on
+    a 'fusion classes' track and the top ops on a 'top ops' track (the
+    buckets are aggregates, so intra-track ordering is by weight, not
+    true time — the per-class totals are what the budget reads)."""
+    events = []
+    for pid, plane in enumerate(rec.get("planes", [])):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": plane.get("plane", "?")}})
+        for tid, (track, items) in enumerate((
+                ("fusion classes",
+                 [(k, v) for k, v in plane.get(
+                     "fusion_class_ms", {}).items()]),
+                ("top ops",
+                 [(e["op"], e["total_ms"])
+                  for e in plane.get("events", [])])), start=1):
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": track}})
+            ts = 0
+            for name, ms in items:
+                dur = max(1, int(ms * 1e3))
+                events.append({"name": name, "cat": "profile",
+                               "ph": "X", "ts": ts, "dur": dur,
+                               "pid": pid, "tid": tid,
+                               "args": {"total_ms": ms}})
+                ts += dur
+    return events
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out = None
+    if "-o" in argv:
+        i = argv.index("-o")
+        if i + 1 >= len(argv):
+            argv = []               # fall through to the usage path
+        else:
+            out = argv[i + 1]
+            del argv[i:i + 2]
+    if len(argv) != 1:
+        print("usage: python -m tools.trace_export "
+              "<trace.json|events.jsonl> [-o out.trace.json]",
+              file=sys.stderr)
+        return 2
+    try:
+        events = load(argv[0])
+        validate_events(events)
+    except (ValueError, json.JSONDecodeError, OSError) as e:
+        print(f"trace_export: {argv[0]}: {e}", file=sys.stderr)
+        return 1
+    if out:
+        write_chrome(events, out, other={"source": argv[0]})
+    print(json.dumps(dict(summarize(events),
+                          **({"wrote": out} if out else {})),
+                     indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
