@@ -471,6 +471,123 @@ def _run_config(a, desc, nrhs, jnp):
     return rec
 
 
+def _prec_ab():
+    """`bench.py --prec`: the mixed-precision A/B — fp32 factor +
+    df64 (two-float fp32) iterative-refinement residual vs the same
+    fp32 factor + native-f64 residual (which TPUs EMULATE).  Same
+    plan, same matrix, two compiled programs; the record carries
+    per-arm wall/GFLOP/s AND the final berr + refinement steps, so
+    the accuracy cost of dropping fp64 from the jitted path is
+    measured next to the speed gain, never assumed.  Appends one JSON
+    line to SLU_PREC_AB_OUT (default PREC_AB.jsonl); CPU rehearsal
+    with JAX_PLATFORMS=cpu measures the arithmetic overhead side
+    (df64 is ~10× the f32 flops per residual term — the interesting
+    number is how little of the fused step that is)."""
+    os.environ.setdefault("SLU_STAGED", "0")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import (cache_dir_for,
+                                              ensure_portable_cpu_isa)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import jax
+    envp = os.environ.get("JAX_PLATFORMS")
+    if envp:
+        try:
+            jax.config.update("jax_platforms", envp)
+        except Exception:
+            pass
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+            os.path.join(repo, ".jax_cache"), accel=on_accel))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import (laplacian_3d,
+                                                manufactured_rhs)
+
+    k = int(os.environ.get("SLU_BENCH_K", "16"))
+    nrhs = int(os.environ.get("SLU_BENCH_NRHS", "1"))
+    a = laplacian_3d(k)
+    xtrue, b = manufactured_rhs(a, nrhs=nrhs)
+    bb = b[:, None] if b.ndim == 1 else b
+    opts = Options(factor_dtype="float32")
+    plan = plan_factorization(a, opts, autotune=True)
+
+    def arm(residual_mode):
+        step = make_fused_solver(plan, dtype="float32",
+                                 residual_mode=residual_mode)
+        vals = jnp.asarray(a.data)
+        t0 = time.perf_counter()
+        x, berr, steps, tiny, nzero = step(vals, bb)
+        if hasattr(x, "block_until_ready"):
+            x.block_until_ready()
+        warm = time.perf_counter() - t0
+        best = np.inf
+        for _ in range(3):
+            t0 = time.perf_counter()
+            x, berr, steps, tiny, nzero = step(vals, bb)
+            if hasattr(x, "block_until_ready"):
+                x.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        x = np.asarray(x)
+        xs = x[:, 0] if xtrue.ndim == 1 else x
+        rel = float(np.linalg.norm(xs - xtrue)
+                    / np.linalg.norm(xtrue))
+        return {
+            "residual_mode": residual_mode,
+            "spmv_layout": step.spmv_layout,
+            "t_warm": warm, "best": best,
+            "gflops": plan.factor_flops / best / 1e9,
+            "berr": float(berr), "refine_steps": int(steps),
+            "relerr": rel,
+        }
+
+    dw = arm("doubleword")
+    f64 = arm("fp64")
+    rec = {
+        "mode": "prec_ab",
+        "n": a.n, "k": k, "nrhs": nrhs,
+        "factor_dtype": "float32",
+        "arms": {"df64_ir": dw, "fp64_ir": f64},
+        "berr_ratio_df64_vs_fp64": dw["berr"] / max(f64["berr"],
+                                                    1e-300),
+        "speedup_df64_vs_fp64": f64["best"] / max(dw["best"], 1e-300),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    # accuracy gate BEFORE the record is persisted: the df64 arm must
+    # land in the df64 class (berr ≤ a few 2^-44) and both arms must
+    # reconstruct the manufactured solution — a failed gate stamps
+    # the line measurement_invalid (the bench.py MFU-gate convention)
+    # and exits 1 so tpu_fire.sh discards it, and the invalid line is
+    # NEVER appended to the tracked JSONL
+    ok = (dw["berr"] < 1e-12 and np.isfinite(f64["berr"])
+          and dw["relerr"] < 1e-9 and f64["relerr"] < 1e-9)
+    if not ok:
+        rec["measurement_invalid"] = True
+    line = json.dumps(rec)
+    print(line)
+    if ok:
+        out_path = os.environ.get("SLU_PREC_AB_OUT",
+                                  os.path.join(repo, "PREC_AB.jsonl"))
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+    else:
+        print("# PREC AB ACCURACY FAILURE (record not persisted)",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+
 def main():
     # --trace PATH: export the run's phase spans + compile events as
     # a Chrome trace-event JSON (Perfetto-loadable) alongside the
@@ -496,6 +613,12 @@ def main():
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "tools", "serve_bench.py"),
             run_name="__main__")
+        return
+    if "--prec" in sys.argv[1:]:
+        # mixed-precision A/B (ISSUE 5): fp32 factor + df64-pair IR
+        # residual vs fp32 factor + native-f64 IR residual, one JSON
+        # line to PREC_AB.jsonl
+        _prec_ab()
         return
     if os.environ.get("SLU_BENCH_PRIME_SCIPY") == "1":
         # baseline priming touches no device — safe anytime, cheap

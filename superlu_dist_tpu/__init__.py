@@ -79,6 +79,7 @@ from .parallel.psymbfact_dist import (  # noqa: E402
     scaled_values_local,
 )
 from .utils.io import read_matrix  # noqa: E402
+from .precision import PrecisionPolicy, ResidualMode  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -101,6 +102,8 @@ __all__ = [
     "plan_factorization_multihost",
     "scaled_values_local",
     "LUFactorization",
+    "PrecisionPolicy",
+    "ResidualMode",
     "factorize",
     "get_diag_u",
     "gssvx",

@@ -206,13 +206,36 @@ def _solve_fused(a, b, opts, stats):
         return x
 
     x = run(opts.factor_dtype)
-    if _should_escalate_fused(opts, stats):
-        # same safety net as gssvx (models/gssvx._should_escalate):
-        # the low-precision factor failed its refinement contract —
-        # rebuild the whole fused program at refine precision on the
-        # SAME plan and rerun
+    # same safety net as gssvx (models/gssvx ladder walk): the
+    # low-precision factor failed its refinement contract — rebuild
+    # the whole fused program one precision rung up on the SAME plan
+    # and rerun, climbing bf16 → fp32 → refine precision until the
+    # contract holds (precision/policy.py; bounded by the ladder)
+    from .. import obs
+    from ..precision.policy import classify_trigger, next_factor_dtype
+    import jax.numpy as jnp
+    cur = opts.factor_dtype
+    while _should_escalate_fused(opts.replace(factor_dtype=cur),
+                                 stats):
+        nxt = next_factor_dtype(cur, ceiling=opts.refine_dtype)
+        if nxt is None:
+            break
         stats.escalations += 1
-        x = run(opts.refine_dtype, phase="FACT_ESC")
+        # stall attribution mirrors the fused loop's own stop rule: a
+        # finite berr with step budget left means the loop quit
+        # because berr stopped halving (the device twin of the host
+        # loop's stalled bit); no lu handle exists here, so the
+        # pivot-growth probe is unavailable by construction
+        stalled = (np.isfinite(stats.berr)
+                   and stats.refine_steps < opts.max_refine_steps)
+        obs.HEALTH.record_escalation(
+            berr=stats.berr, factor_dtype=cur,
+            refine_dtype=opts.refine_dtype, to_dtype=nxt,
+            trigger=classify_trigger(
+                stats.berr, stalled=stalled,
+                factor_eps=float(jnp.finfo(jnp.dtype(cur)).eps)))
+        x = run(nxt, phase="FACT_ESC")
+        cur = nxt
     return np.asarray(x)
 
 

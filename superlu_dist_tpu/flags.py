@@ -49,6 +49,11 @@ FLAGS: dict[str, str] = {
     "SLU_TRACE": "Chrome trace-event JSON export path, written at process exit (1 = ./last.trace.json; implies SLU_OBS; ~1 µs + one dict per span while on)",
     "SLU_TRACE_JSONL": "JSONL event-log path, appended through as spans close (implies SLU_OBS; adds one file write per span)",
     "SLU_OBS_COST": "1 = XLA cost-analysis FLOP/byte accounting on each jit cache miss -> Stats.ops_measured (re-pays one AOT lower+compile per NEW signature; zero cost on the recompile-free hot path)",
+    # --- mixed precision (precision/, options.py, serve/service.py) ---
+    "SLU_PREC_RESIDUAL": "auto|plain|doubleword|fp64 default Options.residual_mode: how the IR residual accumulates (doubleword = two-float fp32 df64, ~25 f32 flops/term vs 2 — noise next to fp64 EMULATION on TPU, and zero f64 ops in the jitted path; host loop uses native f64 either way)",
+    "SLU_PREC_LADDER": "comma dtype list overriding the escalation ladder (default bfloat16,float32,float64; sorted by eps, climbed one rung per failed refinement contract — each rung re-pays one factorization)",
+    "SLU_PREC_TIERS": "1 = serve-layer dtype-TIER serving: a cold high-precision request rides resident lower-rung factors via df64 refinement (saves a cold factorization; costs ~2-3 extra refinement sweeps per solve, berr-guarded with automatic re-key on miss)",
+    "SLU_PREC_AB_OUT": "bench.py --prec output path (default PREC_AB.jsonl)",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---
@@ -90,6 +95,7 @@ FLAGS: dict[str, str] = {
     "SLU_SERVE_LINGER_MS": "serve_bench micro-batcher max linger (ms, default 2)",
     "SLU_SERVE_OUT": "serve_bench output path (default SERVE_LATENCY.jsonl)",
     "SLU_SERVE_MIN_SPEEDUP": "serve_bench regression floor on batched-vs-sequential speedup (default 1.0 = never lose; timeshared-box noise)",
+    "SLU_SERVE_MIXED": "1 = serve_bench mixed-dtype-traffic scenario: same matrix at two precision rungs (f64 native + f32/df64), alternating traffic, pinning ZERO recompiles across rungs on the obs compile counter",
 }
 
 # Tokens the registry test's grep will hit that are NOT env flags:

@@ -227,6 +227,16 @@ def solve(lu: LUFactorization, b: np.ndarray,
             f"b has {b.shape[0]} rows but the matrix is {plan.n}×{plan.n}")
     squeeze = b.ndim == 1
     bb = b[:, None] if squeeze else b
+    if options.solve_dtype is not None:
+        # PrecisionPolicy.solve_dtype: pin the sweep-RHS precision
+        # instead of letting the caller's RHS dtype promote the whole
+        # solve pipeline (an fp32 service pipeline must not pay fp64
+        # sweeps because a client sent a float64 buffer).  Realness is
+        # the system's, precision is the policy's.
+        sdt = np.dtype(options.solve_dtype)
+        if np.issubdtype(bb.dtype, np.complexfloating):
+            sdt = np.promote_types(sdt, np.complex64)
+        bb = bb.astype(sdt)
 
     if options.trans == Trans.CONJ:
         # (Aᴴ)⁻¹·b = conj((Aᵀ)⁻¹·conj(b)) — run the TRANS pipeline
@@ -279,11 +289,12 @@ def solve(lu: LUFactorization, b: np.ndarray,
         if options.iter_refine != IterRefine.NOREFINE and lu.a is not None:
             from .refine import iterative_refine
             with stats.timer("REFINE"):
-                x, berr, steps = iterative_refine(
+                x, berr, steps, stalled = iterative_refine(
                     lu, bb, x, solver, to_factor_rhs, from_factor_sol,
                     trans=(options.trans == Trans.TRANS))
             stats.berr = berr
             stats.refine_steps += steps
+            stats.refine_stalled = stalled
 
     return x[:, 0] if squeeze else x
 
@@ -293,9 +304,13 @@ def solve_rhs_dtype(lu: LUFactorization) -> np.dtype:
     promote_types against the factors — the ONE definition of the
     compiled solve program's operand dtype, shared by warm_solve and
     the serve micro-batcher (warming a different dtype compiles the
-    wrong program)."""
-    return np.promote_types(
-        np.dtype(lu.effective_options.factor_dtype), np.float64)
+    wrong program).  An explicit Options.solve_dtype
+    (PrecisionPolicy's sweep-precision pin) replaces the float64
+    default the promotion otherwise assumes of the RHS."""
+    opts = lu.effective_options
+    rhs = (np.dtype(opts.solve_dtype) if opts.solve_dtype is not None
+           else np.dtype(np.float64))
+    return np.promote_types(np.dtype(opts.factor_dtype), rhs)
 
 
 def warm_solve(lu: LUFactorization, nrhs_widths=(1,),
@@ -479,26 +494,62 @@ def _gssvx_impl(options, a, b, stats, backend, lu,
                        user_perm_r=user_perm_r, user_perm_c=user_perm_c,
                        grid=grid)
     x = solve(lu, b, stats=stats)
-    if _should_escalate(options, lu, stats):
-        # the low-precision factor failed its refinement contract
-        # (cond(A)·eps_factor ≥ 1: berr stagnated far above the
-        # refine-precision class).  Refactor ONCE at refine precision
-        # — the safety net the psgssvx_d2 strategy (SURVEY.md §2.6,
-        # psgssvx_d2.c:516) leaves to the caller, automatic here
-        # because GESP has no mid-factor pivoting to fall back on.
-        # The plan is value-identical, so it is reused outright.
+    # Precision-escalation LADDER (precision/policy.py): when a
+    # low-precision factor fails its refinement contract
+    # (cond(A)·eps_factor ≥ 1: berr stagnates far above the
+    # refine-precision class), re-factor at the NEXT rung up —
+    # bf16 → fp32 → refine_dtype — instead of jumping straight to the
+    # top: on an accelerator the middle rung (fp32 + extended-
+    # precision residual) is full-rate MXU arithmetic while the top
+    # rung is emulated, and most bf16 failures are rescued one rung
+    # up.  This is the safety net the psgssvx_d2 strategy (SURVEY.md
+    # §2.6, psgssvx_d2.c:516) leaves to the caller, automatic here
+    # because GESP has no mid-factor pivoting to fall back on.  The
+    # plan is value-identical across rungs, so it is reused outright;
+    # each promotion is a health event labeled with the signal that
+    # fired (berr plateau / refine stall / pivot growth / overflow).
+    # Terminates: eps(factor) strictly decreases toward the
+    # refine_dtype ceiling, where _escalation_core returns False.
+    from ..precision.policy import next_factor_dtype
+    while True:
+        trigger = _escalation_trigger(options, lu, stats)
+        if trigger is None:
+            break
+        cur = lu.effective_options.factor_dtype
+        nxt = next_factor_dtype(cur, ceiling=options.refine_dtype)
+        if nxt is None:
+            break
         stats.escalations += 1
         obs.HEALTH.record_escalation(
-            berr=stats.berr,
-            factor_dtype=lu.effective_options.factor_dtype,
-            refine_dtype=options.refine_dtype)
-        opts2 = options.replace(factor_dtype=options.refine_dtype)
+            berr=stats.berr, factor_dtype=cur,
+            refine_dtype=options.refine_dtype,
+            to_dtype=nxt, trigger=trigger)
+        opts2 = options.replace(factor_dtype=nxt)
         # the rerun reports under FACT_ESC so FACT's GFLOP/s never
         # blends two differently-precisioned factorizations
         lu = factorize(a, opts2, plan=lu.plan, stats=stats,
                        backend=backend, grid=grid, _phase="FACT_ESC")
         x = solve(lu, b, stats=stats)
     return x, lu, stats
+
+
+def _escalation_trigger(options: Options, lu: LUFactorization,
+                        stats: Stats):
+    """None when the refinement contract held; otherwise the
+    health-signal label (precision/policy.classify_trigger) justifying
+    one ladder rung up.  The pivot-growth probe walks diag(U) to the
+    host (O(n) + a transfer) — paid only once the berr gate has
+    already decided to escalate, never on the happy path."""
+    if not _should_escalate(options, lu, stats):
+        return None
+    import jax.numpy as jnp
+    from ..precision.policy import classify_trigger
+    f_eps = float(jnp.finfo(jnp.dtype(
+        lu.effective_options.factor_dtype)).eps)
+    return classify_trigger(stats.berr,
+                            stalled=stats.refine_stalled,
+                            pivot_growth=obs.pivot_growth(lu),
+                            factor_eps=f_eps)
 
 
 def _should_escalate(options: Options, lu: LUFactorization,

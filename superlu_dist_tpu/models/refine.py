@@ -22,15 +22,25 @@ from .. import obs
 
 
 def _refine_dtype(opts, a_dtype):
-    """SLU_SINGLE accumulates residuals in the working (factor)
-    precision; SLU_DOUBLE in refine_dtype (f64 by default) — the
-    psgsrfs vs psgsrfs_d2 distinction.  A complex system promotes the
-    accumulator to the matching complex dtype (refine_dtype names the
-    *precision*, the matrix decides realness — the reference's z twin
-    files hard-code doublecomplex here)."""
-    from ..options import IterRefine
-    if opts.iter_refine == IterRefine.SLU_SINGLE:
+    """The accumulator dtype per the resolved residual mode
+    (precision/policy.resolve_residual_mode — ONE resolution shared
+    with the fused device solver): PLAIN accumulates in the working
+    (factor) precision, FP64 in refine_dtype (f64 by default) — the
+    psgsrfs vs psgsrfs_d2 distinction.  DOUBLEWORD on this HOST loop
+    accumulates in native float64: the df64 fp32-pair kernels exist to
+    avoid fp64 *emulation* on accelerators (precision/doubleword.py),
+    and on a CPU with hardware fp64 the native accumulator is both
+    faster and a few bits tighter — same contract (residual carries
+    ≥2× factor precision), better lowering for the backend.  A complex
+    system promotes the accumulator to the matching complex dtype
+    (the mode names the *precision*, the matrix decides realness — the
+    reference's z twin files hard-code doublecomplex here)."""
+    from ..precision.policy import ResidualMode, resolve_residual_mode
+    mode = resolve_residual_mode(opts)
+    if mode == ResidualMode.PLAIN.value:
         base = np.dtype(opts.factor_dtype)
+    elif mode == ResidualMode.DOUBLEWORD.value:
+        base = np.dtype(np.float64)
     else:
         base = np.dtype(opts.refine_dtype)
     if np.issubdtype(np.dtype(a_dtype), np.complexfloating):
@@ -132,9 +142,14 @@ def iterative_refine(lu, b, x, solve_factored, to_factor_rhs,
     # improving, nor one whose last halving landed at machine
     # precision (berr can't halve below eps), is a stall
     converged = bool(berr <= eps)
+    stalled = stalled and not converged
     obs.HEALTH.record_refine(berr=berr, steps=steps,
                              berr_trajectory=berr_traj,
                              ferr_trajectory=ferr_traj,
                              converged=converged,
-                             stalled=stalled and not converged)
-    return xk, berr, steps
+                             stalled=stalled)
+    # `stalled` rides back to the driver: the escalation ladder
+    # (gssvx) labels its health event with the signal that fired
+    # (precision/policy.classify_trigger), and "the loop quit because
+    # berr stopped halving" is that signal's ground truth
+    return xk, berr, steps, stalled

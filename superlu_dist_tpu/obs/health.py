@@ -45,6 +45,10 @@ class HealthMonitor:
         self.last_berr = 0.0
         self.last_pivot_growth = 0.0
         self._recent = collections.deque(maxlen=recent_cap)
+        # precision-rung promotions: {trigger: count} + a bounded ring
+        # of {from_dtype, to_dtype, trigger, berr} events
+        self.escalations_by_trigger: dict = {}
+        self._esc_recent = collections.deque(maxlen=recent_cap)
 
     # -- recording hooks ----------------------------------------------
 
@@ -90,16 +94,34 @@ class HealthMonitor:
                                   "steps": int(steps)})
 
     def record_escalation(self, *, berr: float, factor_dtype: str,
-                          refine_dtype: str) -> None:
-        """The low-precision factor failed its refinement contract and
-        gssvx is re-factoring at refine precision — the loudest health
-        event there is."""
+                          refine_dtype: str,
+                          to_dtype: str | None = None,
+                          trigger: str = "berr_plateau") -> None:
+        """One precision-rung promotion — the loudest health event
+        there is: a low-precision factor failed its refinement
+        contract and the driver (gssvx ladder / serve dtype tier) is
+        re-factoring one rung up.  `to_dtype` is the rung being
+        promoted to (None: legacy callers, implies refine_dtype);
+        `trigger` names the signal that fired
+        (precision/policy.classify_trigger: berr_plateau |
+        refine_stalled | pivot_growth | nonfinite | tier_berr).  The
+        recent ring + per-trigger counters surface in snapshot() and
+        the registry's dump_text()."""
+        to_dtype = to_dtype or refine_dtype
         with self._lock:
             self.escalations += 1
+            self.escalations_by_trigger[trigger] = \
+                self.escalations_by_trigger.get(trigger, 0) + 1
+            self._esc_recent.append({
+                "from_dtype": factor_dtype, "to_dtype": to_dtype,
+                "trigger": trigger, "berr": float(berr),
+            })
         _tracer.instant("health.escalation", cat="health",
                         args={"berr": float(berr),
                               "factor_dtype": factor_dtype,
-                              "refine_dtype": refine_dtype})
+                              "refine_dtype": refine_dtype,
+                              "to_dtype": to_dtype,
+                              "trigger": trigger})
 
     # -- readers -------------------------------------------------------
 
@@ -116,6 +138,15 @@ class HealthMonitor:
                 "last_berr": self.last_berr,
                 "last_pivot_growth": self.last_pivot_growth,
                 "last_solve": dict(last) if last else None,
+                # {trigger: count} flattens into dump_text lines
+                # (slu_health_escalations_by_trigger_<t>); the event
+                # ring is the structured view
+                "escalations_by_trigger":
+                    dict(self.escalations_by_trigger),
+                "escalation_events":
+                    [dict(e) for e in self._esc_recent],
+                "last_escalation": (dict(self._esc_recent[-1])
+                                    if self._esc_recent else None),
             }
 
     def summary(self) -> str:

@@ -2097,7 +2097,8 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                       refine_dtype=None,
                       max_steps: Optional[int] = None,
                       mesh=None, axis=None,
-                      staged: Optional[bool] = None):
+                      staged: Optional[bool] = None,
+                      residual_mode: str = "auto"):
     """Build `step(vals, b) -> (x, berr, steps, tiny, nzero)`: the
     ENTIRE pdgssvx numeric pipeline as ONE XLA program — scale +
     assemble + level-batched factorization in `dtype`, trisolve, then
@@ -2144,15 +2145,78 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     # only (mesh complex stays on the replicated native formulation
     # behind its own gate).
     pair = mesh is None and _pair_mode(dtype)
+    # ---- residual-accumulation mode (precision/policy.py): "plain"
+    # (working precision), "fp64" (native refine_dtype — exact on CPU,
+    # EMULATED on TPU), or "doubleword" (two-float fp32 df64 pairs,
+    # precision/doubleword.py — zero f64 ops in the lowered program;
+    # the psgsrfs_d2 residual re-expressed in MXU-native arithmetic).
+    # "auto" resolves through the plan's Options so this function and
+    # models/refine.py cannot disagree on what a policy means. ----
+    from ..precision.policy import resolve_residual_mode
+    mode = (residual_mode if residual_mode != "auto"
+            else resolve_residual_mode(plan.options))
+    if mode not in ("plain", "doubleword", "fp64"):
+        raise ValueError(f"unknown residual_mode {mode!r}; expected "
+                         "auto|plain|doubleword|fp64")
+    # doubleword also requires a factor dtype COARSER than the df64
+    # class: an f64 factor under a doubleword policy (the escalation
+    # ladder's top rung) would have its values rounded to fp32 pairs
+    # and its refinement capped at DF64_EPS — a silent no-op rung —
+    # so the top rung accumulates natively instead (exactly
+    # ladder_policies' PLAIN-at-target contract)
+    _dw_unsupported = (mesh is not None or pair
+                       or np.dtype(dtype).kind == "c"
+                       or (np.dtype(dtype).kind == "f"
+                           and np.dtype(dtype).itemsize >= 8))
+    if mode == "doubleword" and _dw_unsupported:
+        if residual_mode == "doubleword":
+            raise ValueError(
+                "residual_mode='doubleword' is the single-device REAL "
+                "fused path for LOW-precision factors (df64 fp32 "
+                "pairs); complex systems ride pair storage, mesh "
+                "execution accumulates in refine_dtype, and an "
+                "f64-class factor gains nothing from fp32 pairs — "
+                "use residual_mode='fp64' there")
+        # a policy default reaching an unsupported formulation
+        # degrades to native accumulation (same accuracy class or
+        # better) instead of throwing into the driver
+        mode = "fp64"
+    if mode == "doubleword":
+        # staged interaction, decided HERE because rdt shapes every
+        # operand built below: the df64 loop lives INSIDE the fused
+        # program (its while-loop state is the fp32 pair), so an
+        # explicitly requested doubleword residual pins the
+        # one-program formulation, while a policy default meeting the
+        # staged compile-boundedness compromise degrades to native
+        # accumulation (the staged host loop's residual jits are
+        # per-group-sized anyway)
+        if staged:
+            if residual_mode == "doubleword":
+                raise ValueError(
+                    "residual_mode='doubleword' requires the fused "
+                    "one-program formulation; pass staged=False")
+            mode = "fp64"
+        elif staged is None and mesh is None and staged_enabled(sched):
+            if residual_mode == "doubleword":
+                staged = False
+            else:
+                mode = "fp64"
+    doubleword = mode == "doubleword"
     if refine_dtype is None:
         # honor the plan's refinement contract (models/refine.py):
-        # SLU_SINGLE accumulates in the working precision, otherwise in
+        # plain accumulates in the working precision, otherwise in
         # options.refine_dtype
-        if plan.options.iter_refine == IterRefine.SLU_SINGLE:
+        if mode == "plain":
             refine_dtype = dtype
         else:
             refine_dtype = plan.options.refine_dtype
     rdt = np.dtype(refine_dtype)
+    if doubleword:
+        # every rdt-typed operand below (scales, pre/post gathers, x0)
+        # becomes the df64 HI-PLANE dtype; the accuracy target is
+        # DF64_EPS (~2^-44), not eps(rdt) — the compiled program never
+        # contains an f64 buffer (HLO-pinned, tests/test_doubleword)
+        rdt = np.dtype(np.float32)
     if dtype.kind == "c" and rdt.kind != "c":
         # complex system: the accumulator keeps its precision but must
         # be complex (mirror models/refine._refine_dtype)
@@ -2202,6 +2266,18 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     ell_src_np, ell_w = ell_from_csr(_indptr_a, plan.coo_cols,
                                      nnz=nnz_a)
     layout = spmv_layout(nnz_a, n, ell_w)
+    if doubleword and layout != "ell":
+        import os
+        if os.environ.get("SLU_SPMV_LAYOUT",
+                          "auto").strip().lower() != "coo":
+            # the df64 COO lane's scatter-add cannot carry a
+            # compensated sum (its row accumulation stays fp32-class,
+            # precision/doubleword.df64_coo_spmv) — for a doubleword
+            # residual, precision outranks the pad-waste heuristic, so
+            # auto forces ELL; only an EXPLICIT SLU_SPMV_LAYOUT=coo
+            # keeps the degraded lane (and the loop then simply stops
+            # on stall above the df64 target)
+            layout = "ell"
     if layout == "ell":
         sdt_e = jnp.int32 if nnz_a < 2**31 - 1 else jnp.int64
         ops["ell_src"] = jnp.asarray(ell_src_np, dtype=sdt_e)
@@ -2466,6 +2542,125 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         step = _wrap_pair(step)
         step.resid_fn = _resid_fn
         step.spmv_layout = layout
+        step.residual_mode = mode
+        return step
+
+    if mesh is None and doubleword:
+        # ---- doubleword (df64) refinement: the psgssvx_d2 inner-
+        # outer scheme with the fp64 residual replaced by two-float
+        # fp32 pairs (precision/doubleword.py).  The public wrapper
+        # splits A's values and b into exact (hi, lo) fp32 planes on
+        # the HOST (split_f64 — the pair-mode _wrap_pair precedent),
+        # so the compiled program never sees an f64 buffer: factor
+        # and sweeps run in `dtype` exactly as the plain path, the
+        # residual r = b − A·x runs in df64 over the scatter-free ELL
+        # band, and the solution accumulates as an fp32 pair carrying
+        # ~48 bits.  Convergence target: DF64_EPS (2^-44), the df64
+        # analog of the reference's berr ≈ eps stopping class. ----
+        from ..precision.doubleword import (DF64_EPS, df_add, df_add_f,
+                                            df64_coo_spmv,
+                                            df64_ell_spmv, join_f64,
+                                            split_f64)
+        per_group_const = [g.dev(squeeze=True) for g in sched.groups]
+        scale32 = jnp.asarray(scale_fac_np.astype(np.float32))
+
+        def _resid_berr_df(vals_hi, vals_lo, abs_vals, bh, bl, xh, xl):
+            """df64 residual + componentwise berr.  The berr
+            numerator reads the hi plane only: rh carries the true
+            residual to full fp32 RELATIVE precision (the
+            cancellation already happened in df64), and the
+            denominator |A||x|+|b| needs no cancellation protection
+            at all."""
+            if layout == "ell":
+                axh, axl = df64_ell_spmv(
+                    ops["ell_cols"], _ell_plane(vals_hi),
+                    _ell_plane(vals_lo), xh, xl)
+                den = ell_spmv(ops["ell_cols"], _ell_plane(abs_vals),
+                               jnp.abs(xh))
+            else:
+                # explicit SLU_SPMV_LAYOUT=coo: the degraded lane
+                # (row sums stay fp32-class; see df64_coo_spmv)
+                axh, axl = df64_coo_spmv(
+                    ops["coo_rows"], ops["coo_cols"], vals_hi,
+                    vals_lo, xh, xl, n)
+                den = coo_spmv(ops["coo_rows"], ops["coo_cols"],
+                               abs_vals, jnp.abs(xh), n)
+            rh, rl = df_add((bh, bl), (-axh, -axl))
+            denom = den + jnp.abs(bh)
+            denom = jnp.where(denom == 0, 1, denom)
+            return (rh, rl), jnp.max(jnp.abs(rh) / denom)
+
+        def _core(vals_hi, vals_lo, bh, bl):
+            # both planes contribute to the scaled factor values: one
+            # fp32 rounding instead of the two a hi-only product pays
+            scaled = vals_hi * scale32 + vals_lo * scale32
+            flats, tiny, nzero = _factor(scaled, per_group_const)
+            abs_vals = jnp.abs(vals_hi)
+
+            def resid_berr(xh, xl):
+                return _resid_berr_df(vals_hi, vals_lo, abs_vals,
+                                      bh, bl, xh, xl)
+
+            if max_steps <= 0:
+                x = _solve_once(flats, bh, per_group_const)
+                _, berr = resid_berr(x, jnp.zeros_like(x))
+                return (x, jnp.zeros_like(x), berr,
+                        jnp.zeros((), jnp.int32), tiny, nzero)
+
+            # same decision structure as the plain step_body loop
+            # (iteration 0 IS the base solve), with the solution and
+            # residual carried as df64 pairs; the sweep RHS is the hi
+            # plane — the correction δ only ever needs fp32 accuracy
+            def cond(state):
+                _, _, _, berr, _, stop = state
+                return jnp.logical_and(jnp.logical_not(stop),
+                                       berr > DF64_EPS)
+
+            def body(state):
+                xh, xl, r32, berr, steps, _ = state
+                d = _solve_once(flats, r32, per_group_const)
+                nh, nl = df_add_f((xh, xl), d)
+                (rh, rl), berr_new = resid_berr(nh, nl)
+                first = steps == 0
+                improved = berr_new < berr * 0.5
+                better = jnp.logical_or(first, berr_new < berr)
+                xh = jnp.where(better, nh, xh)
+                xl = jnp.where(better, nl, xl)
+                r32 = jnp.where(better, rh + rl, r32)
+                berr = jnp.where(better, berr_new, berr)
+                stop = jnp.logical_or(
+                    jnp.logical_and(jnp.logical_not(first),
+                                    jnp.logical_not(improved)),
+                    steps + 1 >= max_steps + 1)
+                return xh, xl, r32, berr, steps + 1, stop
+
+            x0 = jnp.zeros((n, bh.shape[1]), jnp.float32)
+            xh, xl, _, berr, steps, _ = jax.lax.while_loop(
+                cond, body,
+                (x0, jnp.zeros_like(x0), bh + bl,
+                 jnp.asarray(np.inf, jnp.float32),
+                 jnp.zeros((), jnp.int32), jnp.zeros((), jnp.bool_)))
+            return (xh, xl, berr, jnp.maximum(steps - 1, 0), tiny,
+                    nzero)
+
+        core = obs.watch_jit("fused_step_dw", jax.jit(_core),
+                             cost_phase="FUSED")
+
+        def step(vals, b):
+            vh, vl = split_f64(np.asarray(vals))
+            bh, bl = split_f64(np.asarray(b))
+            xh, xl, berr, steps, tiny, nzero = core(
+                jnp.asarray(vh), jnp.asarray(vl),
+                jnp.asarray(bh), jnp.asarray(bl))
+            # recombine to float64 on the HOST — the program's own
+            # arithmetic never touched f64 (pinned by lowering _core)
+            x = join_f64(np.asarray(xh), np.asarray(xl))
+            return x, berr, steps, tiny, nzero
+
+        step._core = core         # f64-free jitted core (HLO pin)
+        step.resid_fn_df = _resid_berr_df   # introspection/test hook
+        step.spmv_layout = layout
+        step.residual_mode = "doubleword"
         return step
 
     if mesh is None:
@@ -2487,6 +2682,7 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                                         cost_phase="FUSED"))
         step.resid_fn = _resid_fn
         step.spmv_layout = layout
+        step.residual_mode = mode
         return step
 
     # mesh execution: group index arrays enter as sharded operands,
@@ -2658,4 +2854,5 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
 
     step.sel = sel
     step.spmv_layout = layout
+    step.residual_mode = mode
     return step

@@ -96,6 +96,29 @@ def ell_spmv(ell_cols, ell_vals, x):
     return jnp.sum(ell_vals * xg, axis=1)
 
 
+def ell_spmv_df64(ell_cols, vals_hi, vals_lo, x_hi, x_lo):
+    """Double-word accumulation lane of the ELL product: A and x as
+    exact (hi, lo) fp32 pairs, the band reduction compensated — the
+    residual r = b − A·x of mixed-precision refinement carries ~2×
+    fp32 precision with zero f64 ops and zero scatters (kernels in
+    precision/doubleword.py; this is the lane
+    ops/batched.make_fused_solver rides under
+    residual_mode="doubleword")."""
+    from ..precision.doubleword import df64_ell_spmv
+    return df64_ell_spmv(ell_cols, vals_hi, vals_lo, x_hi, x_lo)
+
+
+def coo_spmv_df64(rows, cols, vals_hi, vals_lo, x_hi, x_lo, n: int):
+    """Double-word COO lane: per-term products are exact df64, but the
+    row scatter-add cannot carry a compensated sum, so accumulation
+    stays fp32-class — strictly better than plain fp32, strictly
+    worse than the ELL lane (see precision/doubleword.df64_coo_spmv).
+    Exists so SLU_SPMV_LAYOUT=coo keeps working under a doubleword
+    policy; auto forces ELL there."""
+    from ..precision.doubleword import df64_coo_spmv
+    return df64_coo_spmv(rows, cols, vals_hi, vals_lo, x_hi, x_lo, n)
+
+
 def _ell_waste_limit() -> float:
     try:
         return float(os.environ.get("SLU_SPMV_ELL_WASTE", "4"))
@@ -129,16 +152,35 @@ class DeviceSpMV:
     ell_cols: jnp.ndarray | None = None
     ell_vals: jnp.ndarray | None = None
     ell_abs: jnp.ndarray | None = None
+    # doubleword planes (build(..., doubleword=True)): the exact fp32
+    # (hi, lo) split of the ORIGINAL f64 values, expanded to the
+    # layout's value planes — matvec_df64's operands
+    vals_lo: jnp.ndarray | None = None
+    ell_vals_lo: jnp.ndarray | None = None
 
     @classmethod
-    def build(cls, a: CSRMatrix, dtype=None) -> "DeviceSpMV":
+    def build(cls, a: CSRMatrix, dtype=None,
+              doubleword: bool = False) -> "DeviceSpMV":
         rows, cols, vals = a.to_coo()
+        vals64 = np.asarray(vals)
         if dtype is not None:
             vals = vals.astype(dtype)
+        if doubleword:
+            from ..precision.doubleword import split_f64
+            v_hi, v_lo = split_f64(vals64)
+            vals = v_hi          # the hi plane IS the fp32 value set
         idt = jnp.int32 if a.n < 2**31 - 1 else jnp.int64
         src, w = ell_from_csr(a.indptr, a.indices)
         layout = spmv_layout(len(vals), a.m, w)
-        ell_c = ell_v = ell_a = None
+        if doubleword and layout != "ell" \
+                and os.environ.get("SLU_SPMV_LAYOUT",
+                                   "auto").strip().lower() != "coo":
+            # precision outranks the pad-waste heuristic for df64
+            # residuals (the COO lane's scatter sum stays fp32-class)
+            layout = "ell"
+        ell_c = ell_v = ell_a = ell_l = low = None
+        if doubleword:
+            low = jnp.asarray(v_lo)
         if layout == "ell":
             # host-side one-time expansion (vals are static here, so
             # the per-call gather the fused solver needs is skipped)
@@ -147,13 +189,16 @@ class DeviceSpMV:
                                 dtype=idt)
             ell_v = jnp.asarray(ve[src])
             ell_a = jnp.asarray(np.abs(ve)[src])
+            if doubleword:
+                le = np.concatenate([v_lo, np.zeros(1, v_lo.dtype)])
+                ell_l = jnp.asarray(le[src])
         return cls(n=a.n,
                    rows=jnp.asarray(rows, dtype=idt),
                    cols=jnp.asarray(cols, dtype=idt),
                    vals=jnp.asarray(vals),
                    abs_vals=jnp.asarray(np.abs(vals)),
                    layout=layout, ell_cols=ell_c, ell_vals=ell_v,
-                   ell_abs=ell_a)
+                   ell_abs=ell_a, vals_lo=low, ell_vals_lo=ell_l)
 
     def matvec(self, x):
         if self.layout == "ell":
@@ -164,3 +209,15 @@ class DeviceSpMV:
         if self.layout == "ell":
             return ell_spmv(self.ell_cols, self.ell_abs, x)
         return coo_spmv(self.rows, self.cols, self.abs_vals, x, self.n)
+
+    def matvec_df64(self, x_hi, x_lo):
+        """y = A·x in double-word precision (build with
+        doubleword=True first); returns the (hi, lo) pair."""
+        if self.vals_lo is None:
+            raise ValueError("DeviceSpMV was not built with "
+                             "doubleword=True")
+        if self.layout == "ell":
+            return ell_spmv_df64(self.ell_cols, self.ell_vals,
+                                 self.ell_vals_lo, x_hi, x_lo)
+        return coo_spmv_df64(self.rows, self.cols, self.vals,
+                             self.vals_lo, x_hi, x_lo, self.n)

@@ -89,8 +89,14 @@ FACTOR_KEY_FIELDS = (
 # escalate (a gssvx driver policy; factorize() never reads it).
 # Per-request solve knobs: merged onto a reused handle by the
 # FACTORED rung (models/gssvx.py gssvx), never part of the cache key.
+# residual_mode/solve_dtype are the solve-side half of a
+# PrecisionPolicy (precision/policy.py): they change how refinement
+# accumulates and what RHS dtype the sweeps compile for, never what
+# factors are computed — so they ride the per-request merge and split
+# batcher variants, not cache entries.
 SOLVE_TIME_FIELDS = ("trans", "iter_refine", "refine_dtype",
-                     "max_refine_steps")
+                     "max_refine_steps", "residual_mode",
+                     "solve_dtype")
 
 
 def merge_solve_options(base: "Options", request: "Options") -> "Options":
@@ -173,6 +179,24 @@ class Options:
     # `refine_dtype`) ---
     factor_dtype: str = "float64"
     refine_dtype: str = "float64"
+    # Refinement-residual accumulation strategy (the residual leg of a
+    # precision/policy.PrecisionPolicy): "auto" keeps the pre-policy
+    # behavior (plain under SLU_SINGLE, refine_dtype under SLU_DOUBLE);
+    # "doubleword" accumulates r = b − A·x in two-float fp32 df64
+    # pairs on the jitted device path — ZERO fp64 ops on TPU
+    # (precision/doubleword.py; the host loop satisfies the same
+    # contract with native f64, which is faster AND tighter on CPU);
+    # "plain"/"fp64" force the two legacy modes.  Resolved ONLY
+    # through precision.policy.resolve_residual_mode.
+    residual_mode: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "SLU_PREC_RESIDUAL", "auto") or "auto")
+    # Triangular-sweep RHS dtype (PrecisionPolicy.solve_dtype): None
+    # follows the factors' promotion rule (solve_rhs_dtype in
+    # models/gssvx.py — a float64 RHS promotes against the factor
+    # dtype); an explicit "float32" keeps an fp32 pipeline end-to-end
+    # instead of silently paying fp64 sweeps for an fp64 RHS.
+    solve_dtype: str | None = None
 
     # --- iterative refinement controls ---
     max_refine_steps: int = 8

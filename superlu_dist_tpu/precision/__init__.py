@@ -1,0 +1,35 @@
+"""precision/ — the mixed-precision subsystem (psgssvx_d2, TPU-native).
+
+Two layers:
+
+  * `doubleword` — two-float "df64" arithmetic in pure fp32 jax ops
+    (Dekker/Knuth error-free transformations): add/mul/dot/axpy plus
+    the df64 accumulation lanes for the ELL/COO refinement-residual
+    SpMV, so `r = b − A·x` carries ~2× fp32 precision with ZERO fp64
+    ops in the jitted TPU path.
+  * `policy` — `PrecisionPolicy` (factor/solve dtype + residual mode +
+    target accuracy) threaded through Options → models → serve, and
+    the adaptive escalation ladder (bf16 → fp32+df64-IR → fp64) driven
+    by obs/health signals.
+
+See DESIGN.md §13 and README "Mixed precision".
+"""
+
+from .doubleword import (DF64_EPS, df64_coo_spmv, df64_ell_spmv,
+                         df_add, df_add_f, df_axpy, df_dot, df_mul,
+                         df_mul_f, df_neg, df_sub, df_sum, join_f64,
+                         quick_two_sum, split_f64, two_prod, two_sum)
+from .policy import (RESIDUAL_MODES, PrecisionPolicy, ResidualMode,
+                     classify_trigger, ladder, ladder_policies,
+                     lower_rungs, next_factor_dtype,
+                     resolve_residual_mode)
+
+__all__ = [
+    "DF64_EPS", "PrecisionPolicy", "RESIDUAL_MODES", "ResidualMode",
+    "classify_trigger", "df64_coo_spmv", "df64_ell_spmv", "df_add",
+    "df_add_f", "df_axpy", "df_dot", "df_mul", "df_mul_f", "df_neg",
+    "df_sub", "df_sum", "join_f64", "ladder", "ladder_policies",
+    "lower_rungs", "next_factor_dtype", "quick_two_sum",
+    "resolve_residual_mode",
+    "split_f64", "two_prod", "two_sum",
+]

@@ -1,0 +1,284 @@
+"""Double-word ("df64") arithmetic: ~2× fp32 precision from fp32 ops.
+
+The psgssvx_d2 mixed-precision driver (SRC/psgssvx_d2.c:516) factors in
+single and recovers double accuracy through iterative refinement whose
+residual `r = b − A·x` is accumulated in double (SRC/psgsrfs_d2.c:229).
+On TPU that residual is the one place fp64 survives in the jitted hot
+path — and the MXU/VPU run fp64 only through slow software emulation.
+This module removes it: a double-word number is an UNEVALUATED SUM of
+two fp32 values `(hi, lo)` with `|lo| ≤ ½ulp(hi)`, carrying ~48
+significant bits, and every operation below is exact-error fp32
+arithmetic (Dekker 1971; Knuth TAOCP §4.2.2; the double-double
+technique of Bailey/Hida/Li's QD library, and the fp32-pair revival on
+accelerators — "Optimizing HPL for Exascale Accelerated Architectures",
+arXiv:2304.10397).
+
+Building blocks:
+
+  * `two_sum(a, b)`  — Knuth's branch-free exact addition: fl(a+b)
+    plus the exact rounding error, 6 flops, no magnitude precondition.
+  * `two_prod(a, b)` — Dekker's exact product via the 2^12+1 split
+    (fp32 has a 24-bit significand; each half fits 12 bits, so the
+    partial products are exact), 17 flops.  No FMA is assumed: XLA
+    has no fma HLO and must not contract `a*b - p` on its own (IEEE
+    semantics are the default; fast-math would break every algorithm
+    here, which is why the kernels live behind tests/test_doubleword's
+    ULP oracle).
+
+On top of those: df64 add/sub/mul/dot/axpy and the residual-SpMV
+accumulation lanes used by `ops/batched.make_fused_solver` when
+`residual_mode="doubleword"` (see `precision/policy.py`).  Everything
+is shape-polymorphic jax (works under jit/vmap) and — the contract the
+HLO pin in tests/test_doubleword.py enforces — lowers with ZERO f64
+ops.
+
+Cost: a df64 SpMV term is ~25 fp32 flops vs 2 for plain fp32 — noise
+against fp64 *emulation* on an accelerator without native fp64, and
+confined to the refinement iterations (the factorization itself stays
+pure fp32/bf16).
+
+Host-side helpers `split_f64`/`join_f64` convert numpy float64 arrays
+to/from exact (hi, lo) fp32 pairs OUTSIDE the jitted program, so the
+compiled step never sees an f64 buffer (the pair-mode complex wrapper
+precedent, ops/batched._wrap_pair).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# fp32 has a 24-bit significand: Dekker's splitter is 2^ceil(24/2)+1.
+_SPLIT = np.float32(4097.0)          # 2**12 + 1
+
+# Unit roundoff of a double-word fp32 value: 2^-24 per limb compounds
+# to ~2^-48 ≈ 3.6e-15 relative; published double-word error bounds
+# (Joldes/Muller/Popescu 2017) put add/mul within a few ulp of that.
+# DF64_EPS is the CONVERGENCE TARGET the device refinement loop uses
+# (ops/batched.make_fused_solver doubleword mode): 2^-44 leaves 4 bits
+# of slack for the SpMV accumulation ladder, mirroring the reference's
+# berr ≈ eps stopping class (SRC/pdgsrfs.c:124) one precision down
+# from fp64.
+DF64_EPS = float(2.0 ** -44)
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def _match_shapes(a, b):
+    """Promote a scalar (or broadcast-shaped) EFT-product operand to
+    its partner's full shape through an unfoldable identity.
+
+    Why this is load-bearing: on XLA:CPU (observed in this
+    container's jaxlib), a multiply whose operand is a TRACED-SCALAR
+    BROADCAST gets fp-CONTRACTED into a neighboring add during fused
+    codegen — `quick_two_sum(p, e)` with `p = x·c` lowered `s = p+e`
+    as `fma(x, c, e)` (the UNROUNDED product) while `s − p` used the
+    rounded `p`, silently destroying the error-free-transformation
+    invariant (the low word came out wrong at fp32-error scale —
+    exactly the bits this module exists to keep).  Neither
+    `lax.optimization_barrier` nor bitcast/reduce_precision
+    laundering survives to codegen; what DOES hold — verified
+    bit-for-bit against eager execution by tests/test_doubleword.py —
+    is that ARRAY×ARRAY multiplies of matching shape are never
+    contracted.  So scalars are promoted to full arrays through
+    `((x − x) + 1)·c`: `x − x` cannot be folded to zero without
+    unsafe FP assumptions (NaN/Inf), so the product operand is a
+    genuine array value, not a broadcast the emitter pattern-matches.
+    Precondition: `a` finite (the df64 domain — non-finite operands
+    already poison any refinement loop long before this matters)."""
+    a, b = _f32(a), _f32(b)
+    if a.shape == b.shape:
+        return a, b
+    # an unfoldable full-shape 1.0: x·0 cannot be simplified to 0
+    # without unsafe FP assumptions (x might be NaN/Inf), so `one` is
+    # a genuine array value at the broadcast shape
+    one = a * np.float32(0.0) + b * np.float32(0.0) + np.float32(1.0)
+    return one * a, one * b
+
+
+# -- error-free transformations --------------------------------------
+
+def two_sum(a, b):
+    """fl(a+b) and its exact rounding error (Knuth; 6 flops,
+    branch-free, no |a| ≥ |b| precondition)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def quick_two_sum(a, b):
+    """fl(a+b) and its exact error, REQUIRING |a| ≥ |b| (or a == 0) —
+    Dekker's 3-flop renormalization step."""
+    s = a + b
+    return s, b - (s - a)
+
+
+def _split(a):
+    """Dekker split: a == hi + lo with both halves carrying ≤ 12
+    significand bits, so products of halves are exact in fp32.  (The
+    splitter is a literal CONSTANT, which the backend does not
+    contract — pinned transitively by the two_prod bit-exactness
+    tests through jit.)"""
+    t = _SPLIT * a
+    hi = t - (t - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """fl(a·b) and its exact rounding error (Dekker; FMA-free).
+    Mismatched operand shapes (a scalar multiplier, a broadcast
+    plane) are promoted to full arrays first — see _match_shapes for
+    why that is a correctness requirement, not a convenience."""
+    a, b = _match_shapes(a, b)
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+# -- df64 arithmetic (operands/results are (hi, lo) fp32 pairs) ------
+
+def df_add(x, y):
+    """Double-word + double-word (Knuth accurate add, ~20 flops;
+    relative error a few 2^-48)."""
+    s1, s2 = two_sum(x[0], y[0])
+    t1, t2 = two_sum(x[1], y[1])
+    s2 = s2 + t1
+    s1, s2 = quick_two_sum(s1, s2)
+    s2 = s2 + t2
+    return quick_two_sum(s1, s2)
+
+
+def df_neg(x):
+    return -x[0], -x[1]
+
+
+def df_sub(x, y):
+    return df_add(x, df_neg(y))
+
+
+def df_add_f(x, f):
+    """Double-word + fp32 (the refinement update x ← x + δ with a
+    single-precision correction δ)."""
+    s1, s2 = two_sum(x[0], f)
+    s2 = s2 + x[1]
+    return quick_two_sum(s1, s2)
+
+
+def df_mul(x, y):
+    """Double-word × double-word (the x[1]·y[1] term is below the
+    result's precision and is dropped, per the standard algorithm).
+    Shape-mismatched pairs (broadcast value planes against multi-RHS
+    vectors) are promoted per _match_shapes."""
+    xh, yh = _match_shapes(x[0], y[0])
+    xl, yl = _match_shapes(x[1], y[1])
+    p, e = two_prod(xh, yh)
+    e = e + (xh * yl + xl * yh)
+    return quick_two_sum(p, e)
+
+
+def df_mul_f(x, f):
+    """Double-word × fp32 (f promoted to a full array first — a
+    traced-scalar multiplier inside an EFT is the exact pattern
+    XLA:CPU fp-contracts, see _match_shapes)."""
+    xh, f = _match_shapes(x[0], f)
+    p, e = two_prod(xh, f)
+    e = e + x[1] * f
+    return quick_two_sum(p, e)
+
+
+def df_axpy(alpha, x, y):
+    """y + alpha·x with df64 pairs (alpha an fp32 scalar or pair)."""
+    ax = df_mul(x, alpha) if isinstance(alpha, tuple) \
+        else df_mul_f(x, alpha)
+    return df_add(y, ax)
+
+
+def df_sum(terms_hi, terms_lo, axis=0):
+    """Compensated reduction of df64 terms along `axis` via a scan of
+    df_add — the accumulation ladder the SpMV lanes ride.  O(k) exact
+    two-sums, error O(k·2^-48) instead of the O(k·2^-24) of a plain
+    fp32 sum."""
+    th = jnp.moveaxis(terms_hi, axis, 0)
+    tl = jnp.moveaxis(terms_lo, axis, 0)
+    zero = jnp.zeros(th.shape[1:], jnp.float32)
+
+    def body(carry, t):
+        return df_add(carry, t), None
+
+    (sh, sl), _ = jax.lax.scan(body, (zero, zero), (th, tl))
+    return sh, sl
+
+
+def df_dot(x, y):
+    """df64 inner product of two df64 vectors ((hi, lo) pairs of
+    1-D fp32 arrays)."""
+    ph, pl = df_mul(x, y)
+    return df_sum(ph, pl, axis=0)
+
+
+# -- residual-SpMV accumulation lanes --------------------------------
+
+def df64_ell_spmv(ell_cols, vals_hi, vals_lo, x_hi, x_lo):
+    """y = A·x with A in padded-ELL form and BOTH A and x double-word:
+    per-row gather of the fixed band (scatter-free, exactly
+    ops/spmv.ell_spmv's dataflow), df64 term products, df_sum over the
+    band.  `vals_hi/vals_lo` are the (n, w) ELL value planes of the
+    exact fp32 split of the fp64 matrix values (pad slots 0 in both
+    planes — a 0-term is exact through every transformation);
+    `x_hi/x_lo` are (n,) or (n, nrhs).  Returns the (hi, lo) pair."""
+    xgh = x_hi[ell_cols]                   # (n, w[, nrhs]) pure gather
+    xgl = x_lo[ell_cols]
+    if x_hi.ndim == 2:
+        vh = vals_hi[:, :, None]
+        vl = vals_lo[:, :, None]
+    else:
+        vh, vl = vals_hi, vals_lo
+    th, tl = df_mul((vh, vl), (xgh, xgl))
+    return df_sum(th, tl, axis=1)
+
+
+def df64_coo_spmv(rows, cols, vals_hi, vals_lo, x_hi, x_lo, n: int):
+    """COO fallback lane: term products are exact df64 pairs, but the
+    row accumulation is two independent fp32 scatter-adds (hi plane +
+    error plane) — XLA's scatter cannot carry a compensated carry, so
+    the SUM reintroduces O(row_degree·2^-24) error on the hi plane.
+    Strictly better than plain fp32 (the product error and the low
+    words of A and x are recovered), strictly worse than the ELL lane;
+    the policy layer therefore forces ELL for doubleword residuals
+    unless SLU_SPMV_LAYOUT=coo explicitly insists (ops/spmv.py)."""
+    xgh = x_hi[cols]
+    xgl = x_lo[cols]
+    if x_hi.ndim == 2:
+        vh, vl = vals_hi[:, None], vals_lo[:, None]
+    else:
+        vh, vl = vals_hi, vals_lo
+    th, tl = df_mul((vh, vl), (xgh, xgl))
+    shape = (n + 1,) + x_hi.shape[1:]
+    yh = jnp.zeros(shape, jnp.float32).at[rows].add(th, mode="drop")
+    yl = jnp.zeros(shape, jnp.float32).at[rows].add(tl, mode="drop")
+    return quick_two_sum(yh[:n], yl[:n])
+
+
+# -- host-side conversion (never inside jit) -------------------------
+
+def split_f64(v: np.ndarray):
+    """Exact numpy split of float64 values into (hi, lo) float32
+    planes: hi = fl32(v), lo = fl32(v − hi).  The subtraction runs in
+    f64 on the HOST (outside any jitted program), and |v| < 2^127
+    makes both roundings exact, so hi + lo == v to df64 precision."""
+    v = np.asarray(v, np.float64)
+    hi = v.astype(np.float32)
+    lo = (v - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def join_f64(hi, lo) -> np.ndarray:
+    """Recombine a (hi, lo) pair into float64 on the host."""
+    return np.asarray(hi, np.float64) + np.asarray(lo, np.float64)

@@ -77,7 +77,8 @@ class MicroBatcher:
                  ladder=BUCKET_LADDER,
                  metrics: Metrics | None = None,
                  solve_fn=None,
-                 dtype=None) -> None:
+                 dtype=None,
+                 cast_rhs: bool = False) -> None:
         self.lu = lu
         self.max_linger_s = max_linger_s
         self.ladder = tuple(sorted(ladder))
@@ -86,9 +87,13 @@ class MicroBatcher:
         # the ONE dtype every batch is assembled in — program identity
         # must not depend on batch composition.  Default: the shared
         # gssvx.solve_rhs_dtype rule (complex factors promote to
-        # c128).  submit() rejects an RHS that would promote past it.
+        # c128).  submit() rejects an RHS that would promote past it —
+        # unless `cast_rhs` (the variant carries an EXPLICIT
+        # Options.solve_dtype, whose whole point is downcasting client
+        # buffers to the pinned sweep precision).
         self.dtype = (np.dtype(dtype) if dtype is not None
                       else solve_rhs_dtype(lu))
+        self.cast_rhs = cast_rhs
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: list[_Request] = []
@@ -108,7 +113,13 @@ class MicroBatcher:
         if b.ndim != 1 or b.shape[0] != self.lu.n:
             raise ValueError(
                 f"rhs must be ({self.lu.n},); got {b.shape}")
-        if np.promote_types(b.dtype, self.dtype) != self.dtype:
+        if self.cast_rhs:
+            # the variant's solve_dtype pin: the compiled program's
+            # dtype wins over the client buffer's (models/gssvx.solve
+            # performs the same cast; doing it here keeps the batch
+            # assembly single-dtype)
+            b = b.astype(self.dtype, copy=False)
+        elif np.promote_types(b.dtype, self.dtype) != self.dtype:
             raise ValueError(
                 f"rhs dtype {b.dtype} would promote the batch past "
                 f"{self.dtype} and change the compiled program; "

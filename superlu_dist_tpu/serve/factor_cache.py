@@ -191,6 +191,36 @@ class FactorCache:
                 self._entries.move_to_end(key)
             return ent.lu
 
+    def resident_lower_tier(self, a: CSRMatrix, options: Options,
+                            rungs,
+                            key: CacheKey | None = None
+                            ) -> Optional[tuple]:
+        """Dtype-TIER probe (precision/policy.py): the first RESIDENT
+        sibling of (a, options) among `rungs` — coarser factor dtypes,
+        probed in the given order (pass precision.lower_rungs's
+        finest-first order so an fp32 resident beats a bf16 one).
+        Returns (tier key, handle, rung dtype) or None.  Pass the
+        request's already-computed `key` to skip re-hashing the
+        matrix: only the OPTIONS leg varies across rungs, so the
+        pattern/values sha1 legs (milliseconds at production nnz) are
+        reused on this hot path.  Probes touch the LRU position (a
+        tier hit IS a use of those factors) but not the hit/miss
+        counters — the tier decision is the service's, not a cache
+        miss."""
+        for d in rungs:
+            t_opts = options.replace(factor_dtype=d)
+            if key is not None:
+                eff = effective_factor_dtype(a.dtype, d).name
+                t_key = CacheKey(pattern=key.pattern,
+                                 values=key.values,
+                                 options=t_opts.factor_key() + (eff,))
+            else:
+                t_key = matrix_key(a, t_opts)
+            t_lu = self.peek(t_key)
+            if t_lu is not None:
+                return t_key, t_lu, d
+        return None
+
     def get(self, key: CacheKey) -> Optional[LUFactorization]:
         """Plain lookup (counts a hit/miss, refreshes LRU position)."""
         with self._lock:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import dataclasses
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -45,7 +46,8 @@ from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
 
-def _merged_solve_fn(options: Options, metrics: Metrics | None = None):
+def _merged_solve_fn(options: Options, metrics: Metrics | None = None,
+                     on_berr=None):
     """Batch solver honoring the request's SOLVE-TIME knobs: the
     gssvx FACTORED-rung merge, applied per dispatch.  The replace copy
     shares the handle's refine_cache container, so refinement
@@ -67,12 +69,17 @@ def _merged_solve_fn(options: Options, metrics: Metrics | None = None):
 
     def fn(lu: LUFactorization, B):
         x, st, merged = raw(lu, B)
-        if (metrics is not None
-                and merged.iter_refine != IterRefine.NOREFINE):
-            metrics.observe("serve.berr", float(st.berr))
-            if st.refine_steps:
-                metrics.observe("serve.refine_steps",
-                                float(st.refine_steps))
+        if merged.iter_refine != IterRefine.NOREFINE:
+            if metrics is not None:
+                metrics.observe("serve.berr", float(st.berr))
+                if st.refine_steps:
+                    metrics.observe("serve.refine_steps",
+                                    float(st.refine_steps))
+            if on_berr is not None:
+                # dtype-tier accuracy guard (SolveService._tier_guard):
+                # a tier-served dispatch whose refined berr missed the
+                # sold accuracy class reports here
+                on_berr(float(st.berr))
         return x
 
     # warmup path: same compiled programs, no metrics — five
@@ -96,6 +103,18 @@ class ServeConfig:
     # cap on live (key, solve-options) batcher variants — each owns a
     # flusher thread; least-recently-used variants retire past the cap
     max_batchers: int = 64
+    # dtype-TIER serving (precision/policy.py; SLU_PREC_TIERS=1 flips
+    # the default): a cold high-precision request whose matrix is
+    # resident at a LOWER ladder rung is served from those factors
+    # through doubleword-residual refinement instead of paying a cold
+    # full-precision factorization — the psgssvx_d2 economics as a
+    # cache policy.  A tier-served solve whose berr misses the sold
+    # accuracy class blocks the tier mapping for that key (health
+    # event `tier_berr`), so subsequent requests re-key to a genuine
+    # full-precision factorization.
+    dtype_tiers: bool = dataclasses.field(
+        default_factory=lambda: bool(int(
+            os.environ.get("SLU_PREC_TIERS", "0") or "0")))
 
 
 class SolveService:
@@ -134,6 +153,10 @@ class SolveService:
         # omit options get the PREFACTORED solve semantics (and its
         # warmed batcher variant), not silently-different defaults
         self._prefactor_opts: dict[CacheKey, Options] = {}
+        # requested keys whose dtype-tier serving missed the sold
+        # accuracy class: never tier-serve them again (the "re-key" —
+        # their next request factors at the requested precision)
+        self._tier_blocked: set[CacheKey] = set()
         self._inflight = 0
         self._closed = False
 
@@ -253,6 +276,22 @@ class SolveService:
         else:
             key = matrix_key(a, options or Options())
             resident = self.cache.peek(key, touch=False) is not None
+            if not resident and self.config.dtype_tiers:
+                tiered = self._tier_lookup(a, options or Options(),
+                                           key)
+                if tiered is not None:
+                    t_key, t_lu, t_opts = tiered
+                    self.metrics.inc("serve.dtype_tier_hits")
+                    mb = self._batcher_for(
+                        t_key, t_lu, t_opts,
+                        on_berr=self._tier_guard(
+                            key, t_key, t_opts))
+                    try:
+                        return mb.submit(b, deadline=deadline)
+                    except ServeError:
+                        raise FactorMissError(
+                            "tier factors evicted concurrently; "
+                            "resubmit to re-factor") from None
             if not resident and self.config.miss_policy == "failfast":
                 self.metrics.inc("serve.miss_failfast")
                 raise FactorMissError(
@@ -275,15 +314,82 @@ class SolveService:
                 "factors evicted concurrently; resubmit (or "
                 "prefactor) to re-factor") from None
 
+    def _tier_lookup(self, a: CSRMatrix, options: Options,
+                     key: CacheKey):
+        """A resident LOWER-precision factorization of this matrix
+        able to serve the request's accuracy class through
+        doubleword-residual refinement (precision/policy.lower_rungs,
+        finest resident rung wins).  Returns (tier key, handle, solve
+        options) or None.  The solve options keep the request's
+        refine_dtype — the accuracy being sold — and switch only the
+        residual strategy, so the berr the guard below checks is
+        measured against the promised class."""
+        from ..options import IterRefine
+        from ..precision.policy import lower_rungs
+        if options.iter_refine == IterRefine.NOREFINE:
+            return None           # nothing recovers the precision gap
+        if np.issubdtype(np.dtype(a.dtype), np.complexfloating) \
+                or np.dtype(options.factor_dtype).kind == "c":
+            return None           # df64 pairs are real machinery
+        with self._lock:
+            if key in self._tier_blocked:
+                return None
+        hit = self.cache.resident_lower_tier(
+            a, options, lower_rungs(options.factor_dtype), key=key)
+        if hit is None:
+            return None
+        t_key, t_lu, d = hit
+        t_opts = options.replace(
+            factor_dtype=d,
+            residual_mode="doubleword",
+            iter_refine=IterRefine.SLU_DOUBLE)
+        return t_key, t_lu, t_opts
+
+    def _tier_guard(self, requested_key: CacheKey, t_key: CacheKey,
+                    t_opts: Options):
+        """Per-dispatch berr watchdog for tier-served traffic: berr
+        above the sold accuracy class (the gssvx escalation gate,
+        64·eps(refine_dtype)) blocks the tier mapping — a health
+        `tier_berr` escalation event, a serve.tier_escalations tick,
+        and every subsequent request for `requested_key` re-keys to a
+        genuine full-precision factorization."""
+        from .. import obs
+        from ..models.gssvx import _ESC_BERR_SLACK
+        limit = _ESC_BERR_SLACK * float(
+            np.finfo(np.dtype(t_opts.refine_dtype)).eps)
+
+        def on_berr(berr: float) -> None:
+            if berr <= limit and np.isfinite(berr):
+                return
+            with self._lock:
+                already = requested_key in self._tier_blocked
+                self._tier_blocked.add(requested_key)
+            if already:
+                return
+            self.metrics.inc("serve.tier_escalations")
+            obs.HEALTH.record_escalation(
+                berr=berr, factor_dtype=t_opts.factor_dtype,
+                refine_dtype=t_opts.refine_dtype,
+                to_dtype=t_opts.refine_dtype, trigger="tier_berr")
+
+        return on_berr
+
     def _batcher_for(self, key: CacheKey, lu: LUFactorization,
-                     options: Options) -> MicroBatcher:
+                     options: Options,
+                     on_berr=None) -> MicroBatcher:
         """One MicroBatcher per (cache key, solve-time options).  Its
         solve_fn merges the request's solve knobs onto the shared
         handle (the gssvx FACTORED rung's merge) so the leader's
         factorization-time knobs never leak into other callers'
         solves — and requests with different trans/refinement never
         land in the same batch."""
-        bkey = (key,) + solve_options_key(options)
+        # tier-served traffic gets its OWN variant (the "tier" leg):
+        # its solve_fn carries the berr guard, and sharing a batcher
+        # created unguarded by direct traffic with the same solve
+        # options would silently drop the guard (and the re-key
+        # contract with it)
+        bkey = (key,) + solve_options_key(options) \
+            + (("tier",) if on_berr is not None else ())
         retired = []
         with self._lock:
             if self._closed:
@@ -305,10 +411,24 @@ class SolveService:
                     raise FactorMissError(
                         "factors evicted concurrently; resubmit to "
                         "re-factor")
+                # assembly dtype from the MERGED options — the dtype
+                # the dispatch's solve() actually compiles for.  An
+                # explicit request solve_dtype both re-types the batch
+                # (no inline recompile on first live dispatch) and
+                # downcasts client buffers (cast_rhs) instead of
+                # tripping the promote-past rejection
+                merged = merge_solve_options(lu.effective_options,
+                                             options)
+                from ..models.gssvx import solve_rhs_dtype
+                mdtype = solve_rhs_dtype(
+                    dataclasses.replace(lu, options=merged))
                 mb = self._batchers[bkey] = MicroBatcher(
                     lu, max_linger_s=self.config.max_linger_s,
                     ladder=self.config.ladder, metrics=self.metrics,
-                    solve_fn=_merged_solve_fn(options, self.metrics))
+                    dtype=mdtype,
+                    cast_rhs=merged.solve_dtype is not None,
+                    solve_fn=_merged_solve_fn(options, self.metrics,
+                                              on_berr=on_berr))
                 while len(self._batchers) > self.config.max_batchers:
                     _, old = self._batchers.popitem(last=False)
                     retired.append(old)

@@ -91,6 +91,10 @@ class Stats:
     tiny_pivots: int = 0
     refine_steps: int = 0
     berr: float = 0.0
+    # last refinement loop quit on a genuine stall (berr stopped
+    # halving short of eps — models/refine.py); the escalation
+    # ladder's trigger classification reads it
+    refine_stalled: bool = False
     # precision escalations: low-precision factor failed refinement,
     # refactored at refine_dtype (gssvx _should_escalate)
     escalations: int = 0
@@ -157,6 +161,7 @@ class Stats:
             "tiny_pivots": self.tiny_pivots,
             "refine_steps": self.refine_steps,
             "berr": self.berr,
+            "refine_stalled": self.refine_stalled,
             "escalations": self.escalations,
             "lu_nnz": self.lu_nnz,
             "lu_bytes": self.lu_bytes,

@@ -1,0 +1,327 @@
+"""df64 double-word arithmetic (precision/doubleword.py, ISSUE 5a).
+
+Three layers of pins:
+
+  * EXACTNESS oracles — Knuth's two_sum and Dekker's two_prod are
+    error-FREE transformations: (result, error) represents the true
+    real-number result exactly, and both the true sum of two fp32 and
+    the true product of two fp32 are representable in float64 (≤ 49 /
+    48 significand bits), so numpy float64 verifies them to the LAST
+    BIT, not to a tolerance.
+  * ULP-class bounds — df64 add/mul/spmv against the numpy float64
+    oracle, bounded by the published double-word error classes
+    (a few 2^-48 relative; the inputs' own (hi, lo) representation
+    error is ~2^-49, so end-to-end bounds sit at small multiples).
+  * HLO pins — the fused doubleword refinement program
+    (make_fused_solver residual_mode="doubleword") lowers with ZERO
+    f64 ops and its residual path with ZERO scatters; the fp64-mode
+    control build DOES contain f64, proving the assertion has teeth.
+    ("f64" is matched with a (?<!d) guard: the substring also occurs
+    inside the *name* df64 in module metadata.)
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from superlu_dist_tpu.precision import doubleword as dw
+
+F64_HAS_F64 = re.compile(r"(?<!d)f64")
+
+
+def _rand(n, scale=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n) * scale).astype(np.float64)
+
+
+# -- error-free transformation exactness ------------------------------
+
+def test_two_sum_is_exact():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal(4096).astype(np.float32)
+    b = (rng.standard_normal(4096) * 10.0 ** rng.integers(
+        -6, 6, 4096)).astype(np.float32)
+    s, e = jax.jit(dw.two_sum)(jnp.asarray(a), jnp.asarray(b))
+    s, e = np.asarray(s, np.float64), np.asarray(e, np.float64)
+    # the true sum a+b equals s+e as REAL numbers (Knuth), and s+e
+    # spans ≤ 49 bits, so float64 holds it exactly — bit equality
+    assert np.array_equal(a.astype(np.float64) + b.astype(np.float64),
+                          s + e)
+
+
+def test_two_prod_is_exact():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal(4096).astype(np.float32)
+    b = (rng.standard_normal(4096) * 10.0 ** rng.integers(
+        -6, 6, 4096)).astype(np.float32)
+    p, e = jax.jit(dw.two_prod)(jnp.asarray(a), jnp.asarray(b))
+    p, e = np.asarray(p, np.float64), np.asarray(e, np.float64)
+    # the true product of two 24-bit significands has ≤ 48 bits:
+    # float64 computes it exactly, and Dekker's pair must equal it
+    assert np.array_equal(a.astype(np.float64) * b.astype(np.float64),
+                          p + e)
+
+
+def test_split_join_roundtrip_df64_class():
+    v = _rand(2048, seed=3) * 10.0 ** _rand(2048, 2, seed=4)
+    hi, lo = dw.split_f64(v)
+    assert hi.dtype == np.float32 and lo.dtype == np.float32
+    # |lo| ≤ ½ulp(hi) (a normalized pair) and the pair carries the
+    # first ~48 bits of v
+    assert np.all(np.abs(lo) <= np.spacing(np.abs(hi)))
+    rel = np.abs(dw.join_f64(hi, lo) - v) / np.abs(v)
+    assert rel.max() < 2.0 ** -47
+
+
+# -- df64 arithmetic ULP bounds ---------------------------------------
+
+def _pair(v):
+    hi, lo = dw.split_f64(v)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (dw.df_add, lambda a, b: a + b),
+    (dw.df_sub, lambda a, b: a - b),
+    (dw.df_mul, lambda a, b: a * b),
+])
+def test_df64_binary_ops_vs_f64_oracle(op, oracle):
+    a = _rand(2048, seed=5)
+    b = _rand(2048, seed=6) * 1e3
+    rh, rl = jax.jit(op)(_pair(a), _pair(b))
+    got = dw.join_f64(np.asarray(rh), np.asarray(rl))
+    ref = oracle(a, b)
+    denom = np.maximum(np.abs(ref), 1e-30)
+    # inputs are only df64-representable (~2^-49 each); the op adds a
+    # few 2^-48 — 2^-44 is 16× headroom over the compound bound
+    assert np.max(np.abs(got - ref) / denom) < 2.0 ** -44
+
+
+def test_df_add_f_and_axpy():
+    x = _rand(512, seed=7)
+    d = _rand(512, seed=8).astype(np.float32)
+    rh, rl = jax.jit(dw.df_add_f)(_pair(x), jnp.asarray(d))
+    ref = x + d.astype(np.float64)
+    got = dw.join_f64(np.asarray(rh), np.asarray(rl))
+    # condition-aware bound: x + d cancels arbitrarily for random
+    # operands, so the error is measured against |x| + |d| (the same
+    # normalization berr uses), not the possibly-tiny result
+    cond = np.abs(x) + np.abs(d)
+    assert np.max(np.abs(got - ref) / cond) < 2.0 ** -44
+    yh, yl = jax.jit(dw.df_axpy)(np.float32(3.0), _pair(x), _pair(x))
+    ref2 = 3.0 * x + x
+    got2 = dw.join_f64(np.asarray(yh), np.asarray(yl))
+    assert np.max(np.abs(got2 - ref2)
+                  / np.maximum(np.abs(ref2), 1e-30)) < 2.0 ** -44
+
+
+def test_scalar_multiplier_eft_survives_jit():
+    """The XLA:CPU fp-contraction hazard (_match_shapes): a
+    traced-scalar multiplier through df_mul_f must produce BITWISE
+    the same pair under jit as eagerly — the jitted fused kernel once
+    contracted s = p + e into fma(x, c, e) and corrupted the low
+    word at fp32-error scale."""
+    x = _rand(512, seed=16)
+    P = _pair(x)
+    f = np.float32(3.0)
+    jh, jl = jax.jit(dw.df_mul_f)(P, f)
+    eh, el = dw.df_mul_f(P, f)
+    # the HI word must agree bitwise (the corrupted EFT shifted it by
+    # whole fp32 ulps before the fix); the LO word may wobble at the
+    # df64 error class (a benign fma inside the error-term chain,
+    # ~2^-46 OF THE VALUE) but never at fp32 scale
+    assert np.array_equal(np.asarray(jh), np.asarray(eh))
+    jl, el = np.asarray(jl), np.asarray(el)
+    assert np.max(np.abs(jl - el) / np.abs(3.0 * x)) < 2.0 ** -44
+    got = dw.join_f64(np.asarray(jh), jl)
+    assert np.max(np.abs(got - 3.0 * x) / np.abs(3.0 * x)) < 2.0 ** -44
+
+
+def test_df_sum_beats_plain_fp32_by_orders():
+    """Compensated reduction: a cancellation-heavy sum where plain
+    fp32 keeps ~0 correct digits and df64 lands at the
+    representation floor (Σ|terms|·2^-49)."""
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal(3000)
+    v = np.concatenate([a, -a])
+    v[0] += 1e-7
+    hi, lo = dw.split_f64(v)
+    sh, sl = jax.jit(lambda h, l: dw.df_sum(h, l, axis=0))(
+        jnp.asarray(hi), jnp.asarray(lo))
+    got = float(dw.join_f64(np.asarray(sh), np.asarray(sl)))
+    ref = float(np.sum(v))
+    floor = np.sum(np.abs(v)) * 2.0 ** -48
+    assert abs(got - ref) < 4 * floor
+    naive = float(np.sum(v.astype(np.float32), dtype=np.float32))
+    assert abs(got - ref) < abs(naive - ref) / 100
+
+
+def test_df_dot_vs_f64():
+    a = _rand(4096, seed=10)
+    b = _rand(4096, seed=11)
+    sh, sl = jax.jit(dw.df_dot)(_pair(a), _pair(b))
+    got = float(dw.join_f64(np.asarray(sh), np.asarray(sl)))
+    ref = float(a @ b)
+    floor = float(np.abs(a) @ np.abs(b)) * 2.0 ** -48
+    assert abs(got - ref) < 8 * max(floor, abs(ref) * 2.0 ** -48)
+
+
+# -- SpMV lanes --------------------------------------------------------
+
+def test_df64_ell_spmv_componentwise_bound():
+    rng = np.random.default_rng(12)
+    n, w = 300, 9
+    cols = rng.integers(0, n, (n, w))
+    vals = rng.standard_normal((n, w))
+    for nrhs in (None, 3):
+        x = rng.standard_normal(n if nrhs is None else (n, nrhs))
+        vh, vl = dw.split_f64(vals)
+        xh, xl = dw.split_f64(x)
+        yh, yl = jax.jit(dw.df64_ell_spmv)(
+            jnp.asarray(cols), jnp.asarray(vh), jnp.asarray(vl),
+            jnp.asarray(xh), jnp.asarray(xl))
+        got = dw.join_f64(np.asarray(yh), np.asarray(yl))
+        sub = "nw,nw->n" if nrhs is None else "nw,nwr->nr"
+        ref = np.einsum(sub, vals, x[cols])
+        den = np.einsum(sub, np.abs(vals), np.abs(x)[cols])
+        # w df64 terms through a compensated scan: a few w·2^-48
+        # componentwise (the berr-denominator normalization)
+        assert np.max(np.abs(got - ref) / den) < 16 * w * 2.0 ** -48
+
+
+def test_df64_ell_spmv_hlo_clean():
+    n, w = 64, 4
+    f = jax.jit(dw.df64_ell_spmv)
+    txt = f.lower(jnp.zeros((n, w), jnp.int32),
+                  *(jnp.zeros((n, w), jnp.float32),) * 2,
+                  *(jnp.zeros(n, jnp.float32),) * 2).as_text()
+    assert not F64_HAS_F64.search(txt)
+    assert "scatter" not in txt
+
+
+def test_df64_coo_spmv_term_exact_sum_fp32_class():
+    """The documented degradation of the COO lane: per-term products
+    are exact df64 pairs but the scatter-add row sum stays fp32-class
+    — it must match the f64 oracle to ~fp32 (NOT df64) precision,
+    which is why the policy layer forces ELL for doubleword
+    residuals."""
+    rng = np.random.default_rng(13)
+    n, deg = 200, 6
+    rows = np.repeat(np.arange(n), deg)
+    cols = rng.integers(0, n, n * deg)
+    vals = rng.standard_normal(n * deg)
+    x = rng.standard_normal(n)
+    vh, vl = dw.split_f64(vals)
+    xh, xl = dw.split_f64(x)
+    yh, yl = jax.jit(lambda *a: dw.df64_coo_spmv(*a, n=n))(
+        jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vh),
+        jnp.asarray(vl), jnp.asarray(xh), jnp.asarray(xl))
+    got = dw.join_f64(np.asarray(yh), np.asarray(yl))
+    ref = np.zeros(n)
+    np.add.at(ref, rows, vals * x[cols])
+    den = np.zeros(n)
+    np.add.at(den, rows, np.abs(vals * x[cols]))
+    comp = np.max(np.abs(got - ref) / den)
+    assert comp < deg * np.finfo(np.float32).eps * 4
+
+
+# -- the fused doubleword refinement program --------------------------
+
+def _fused_dw_setup(k=12):
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_2d
+    a = laplacian_2d(k)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    rng = np.random.default_rng(14)
+    xtrue = rng.standard_normal((a.n, 1))
+    b = a.to_scipy() @ xtrue
+    return a, plan, xtrue, b, make_fused_solver
+
+
+def test_fused_doubleword_converges_to_df64_class():
+    a, plan, xtrue, b, mk = _fused_dw_setup()
+    step = mk(plan, dtype="float32", residual_mode="doubleword")
+    assert step.residual_mode == "doubleword"
+    assert step.spmv_layout == "ell"
+    x, berr, steps, tiny, nzero = step(a.data, b)
+    assert isinstance(x, np.ndarray) and x.dtype == np.float64
+    assert float(berr) < 2 * dw.DF64_EPS
+    assert int(steps) >= 1
+    relerr = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert relerr < 1e-11
+    # teeth: a PLAIN fp32 residual on the same program structure
+    # cannot reach the df64 class — the extended precision is real
+    step_plain = mk(plan, dtype="float32", residual_mode="plain",
+                    refine_dtype="float32")
+    _, berr_p, *_ = step_plain(jnp.asarray(a.data), jnp.asarray(b))
+    assert float(berr_p) > 100 * float(berr)
+
+
+def test_fused_doubleword_hlo_has_zero_f64_ops():
+    """THE acceptance pin: the entire jitted df64 refine program —
+    scale, factor, sweeps, df64 residual, while_loop — lowers with no
+    f64 type anywhere; the fp64-residual control build of the same
+    plan DOES lower f64, so the regex has teeth."""
+    a, plan, _, b, mk = _fused_dw_setup()
+    step = mk(plan, dtype="float32", residual_mode="doubleword")
+    vh = np.zeros(a.nnz, np.float32)
+    bh = np.zeros((a.n, 1), np.float32)
+    txt = step._core.lower(vh, vh, bh, bh).as_text()
+    assert not F64_HAS_F64.search(txt), "f64 leaked into the df64 path"
+    control = mk(plan, dtype="float32", residual_mode="fp64")
+    txt64 = jax.jit(control).lower(
+        jnp.zeros(a.nnz, np.float64),
+        jnp.zeros((a.n, 1), np.float64)).as_text()
+    assert F64_HAS_F64.search(txt64), "control build should carry f64"
+
+
+def test_fused_doubleword_residual_path_scatter_free():
+    """The df64 residual+berr computation alone (the per-iteration
+    body cost): zero scatters (ELL lane) and zero f64."""
+    a, plan, _, b, mk = _fused_dw_setup()
+    step = mk(plan, dtype="float32", residual_mode="doubleword")
+    nnz, n = a.nnz, a.n
+    txt = jax.jit(step.resid_fn_df).lower(
+        *(jnp.zeros(nnz, jnp.float32),) * 3,
+        *(jnp.zeros((n, 1), jnp.float32),) * 4).as_text()
+    assert "scatter" not in txt
+    assert not F64_HAS_F64.search(txt)
+
+
+def test_fused_doubleword_rejects_unsupported_combos():
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.ops.batched import make_fused_solver
+    from superlu_dist_tpu.plan.plan import plan_factorization
+    from superlu_dist_tpu.utils.testmat import laplacian_2d
+    a = laplacian_2d(6)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    with pytest.raises(ValueError, match="staged"):
+        make_fused_solver(plan, dtype="float32",
+                          residual_mode="doubleword", staged=True)
+    with pytest.raises(ValueError, match="unknown residual_mode"):
+        make_fused_solver(plan, dtype="float32",
+                          residual_mode="df64ish")
+
+
+def test_device_spmv_doubleword_build():
+    from superlu_dist_tpu.ops.spmv import DeviceSpMV
+    from superlu_dist_tpu.utils.testmat import laplacian_2d
+    a = laplacian_2d(7)
+    mv = DeviceSpMV.build(a, doubleword=True)
+    rng = np.random.default_rng(15)
+    x = rng.standard_normal(a.n)
+    xh, xl = dw.split_f64(x)
+    yh, yl = mv.matvec_df64(jnp.asarray(xh), jnp.asarray(xl))
+    got = dw.join_f64(np.asarray(yh), np.asarray(yl))
+    ref = a.to_scipy() @ x
+    den = np.abs(a.to_scipy()) @ np.abs(x) + 1e-300
+    assert np.max(np.abs(got - ref) / den) < 1e-12
+    plain = DeviceSpMV.build(a)
+    with pytest.raises(ValueError, match="doubleword"):
+        plain.matvec_df64(jnp.asarray(xh), jnp.asarray(xl))

@@ -178,6 +178,30 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     fi
     rm -f "$ab_tmp"
   done
+  # 5b. Mixed-precision A/B (bench.py --prec): fp32 factor + df64
+  #     two-float IR residual vs fp32 factor + f64-EMULATED IR
+  #     residual — same plan, two programs; records GFLOP/s AND final
+  #     berr per arm to PREC_AB.jsonl.  On TPU the f64 arm pays the
+  #     emulation tax inside every refinement sweep; the df64 arm
+  #     prices exactly what precision/doubleword.py recovers.  The
+  #     fp64 arm's program is the primary bench's (warm from step 1's
+  #     cache at the same k); only the df64 program compiles cold.
+  #     Promoted only when the run stayed on hardware, like every
+  #     other arm (a CPU box has native f64 — its A/B answers a
+  #     different question and goes to the log, not the record).
+  prec_tmp=$(mktemp)
+  env SLU_BENCH_ASSUME_LIVE=1 SLU_BENCH_K="${SLU_BENCH_K:-30}" \
+    SLU_PREC_AB_OUT="$prec_tmp" \
+    timeout 1200 python "$repo/bench.py" --prec > /dev/null 2>> "$log"
+  rc=$?
+  if [ $rc -eq 0 ] && ! grep -q '"platform": "cpu"' "$prec_tmp"; then
+    cat "$prec_tmp" >> "$repo/PREC_AB.jsonl"
+    stamp "prec A/B rc=$rc (recorded)"
+  else
+    cat "$prec_tmp" >> "$log" 2>/dev/null || true
+    stamp "prec A/B rc=$rc cpu/failed; discarded"
+  fi
+  rm -f "$prec_tmp"
   # 6. Secondary configs (nrhs=64, n=110k, n=262k) — sweep appends to
   #    BENCH_SWEEP.jsonl as each record lands, so a dying window
   #    keeps the completed ones.  Per-config budget 2400 s: the scipy
