@@ -54,6 +54,11 @@ FLAGS: dict[str, str] = {
     "SLU_PREC_LADDER": "comma dtype list overriding the escalation ladder (default bfloat16,float32,float64; sorted by eps, climbed one rung per failed refinement contract — each rung re-pays one factorization)",
     "SLU_PREC_TIERS": "1 = serve-layer dtype-TIER serving: a cold high-precision request rides resident lower-rung factors via df64 refinement (saves a cold factorization; costs ~2-3 extra refinement sweeps per solve, berr-guarded with automatic re-key on miss)",
     "SLU_PREC_AB_OUT": "bench.py --prec output path (default PREC_AB.jsonl)",
+    # --- resilience (resilience/, serve/factor_cache.py) ---
+    "SLU_FT_STORE": "durable factor-store directory: FactorCache write-through/read-through persistence tier (atomic rename + sha256 framing + per-array ABFT checksum; corrupt entries quarantined to *.quarantined, never served; a restarted replica boots warm)",
+    "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds); deterministic per-site seeded streams; every site is one pointer check when unset",
+    "SLU_CHAOS_SEED": "chaos RNG seed (default 0): same spec+seed replays the identical failure sequence",
+    "SLU_CHAOS_OUT": "serve_bench --chaos record path (default CHAOS.jsonl)",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---

@@ -396,6 +396,43 @@ def get_diag_u(lu: LUFactorization) -> np.ndarray:
     return out
 
 
+def factor_arrays(lu: LUFactorization) -> list:
+    """The numeric factor payload as HOST arrays in a deterministic
+    order — the ABFT-lite surface the resilience layer checksums,
+    validates and persists (resilience/store.py).  Host panels come
+    back as the live numpy objects; device flats cross to the host
+    (an O(factor bytes) transfer — callers are the once-per-
+    factorization save/validate paths, never a solve).  The dist
+    backend's factors are mesh-bound and raise."""
+    if lu.backend == "host":
+        h = lu.host_lu
+        return [np.asarray(p)
+                for side in (h.L, h.U, h.Linv, h.Uinv) for p in side]
+    if lu.backend == "dist":
+        raise ValueError(
+            "dist-backend factors are sharded over a live mesh and "
+            "have no host-array form; persist the single-device "
+            "factorization instead")
+    d = lu.device_lu
+    if hasattr(d, "panels"):          # StagedLU: per-group local flats
+        return [np.asarray(a) for p in d.panels for a in p]
+    return [np.asarray(d.L_flat), np.asarray(d.U_flat),
+            np.asarray(d.Li_flat), np.asarray(d.Ui_flat)]
+
+
+def factors_finite(lu: LUFactorization) -> bool:
+    """True when every factor entry is finite — the containment gate
+    between a factorization and any cache/store/serve surface: a
+    NaN/Inf-poisoned factor produces silently-wrong solves under GESP
+    (no runtime pivoting to catch it), so the serve layer refuses to
+    admit one (serve/factor_cache.py raises FactorPoisoned)."""
+    try:
+        arrays = factor_arrays(lu)
+    except ValueError:
+        return True     # mesh-bound factors: nothing to probe here
+    return all(bool(np.isfinite(a).all()) for a in arrays)
+
+
 def query_space(lu: LUFactorization) -> dict:
     """LU storage accounting (dQuerySpace_dist analog,
     SRC/superlu_ddefs.h:616): true nnz(L+U) and the bytes actually

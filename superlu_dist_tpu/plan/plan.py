@@ -57,6 +57,18 @@ class FactorPlan:
     # this field.
     true_factor_flops: float = 0.0
 
+    def __getstate__(self):
+        # runtime attach points (ops/batched.get_schedule's
+        # _batched_schedules, factor_dist's _dist_factor_fns) hold
+        # jitted closures and device buffers — never picklable, and
+        # rebuilt deterministically from the plan on the other side.
+        # Stripping them here is what makes the plan (and with it the
+        # durable factor store, resilience/store.py) serializable.
+        state = dict(self.__dict__)
+        for k in ("_batched_schedules", "_dist_factor_fns"):
+            state.pop(k, None)
+        return state
+
     @property
     def nsuper(self) -> int:
         return self.frontal.nsuper

@@ -1,0 +1,113 @@
+"""Per-key circuit breaker for repeatedly-failing factorizations.
+
+The economics that make the factor cache worth building also make a
+POISONED key catastrophic: a matrix whose factorization reliably
+fails (singular after scaling, overflowing at the requested dtype,
+chaos-injected) costs a full factorization attempt — minutes at
+production scale — per request that misses on it.  The breaker turns
+that into: `threshold` failures open the circuit, every request during
+`cooldown_s` gets an immediate FactorPoisoned (one error, no retry
+storm), then ONE half-open probe is admitted; success closes the
+circuit, failure re-opens it for another cooldown.  The standard
+three-state breaker, keyed per cache key.
+
+The clock is injectable so tests drive the open→half-open→closed
+cycle without sleeping.  State transitions tick a metrics counter and
+an obs trace instant when wired (duck-typed: anything with `inc`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _KeyState:
+    __slots__ = ("failures", "state", "opened_at", "probing",
+                 "probe_at")
+
+    def __init__(self) -> None:
+        self.failures = 0
+        self.state = "closed"
+        self.opened_at = 0.0
+        self.probing = False
+        self.probe_at = 0.0
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 clock=time.monotonic, metrics=None) -> None:
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._keys: dict = {}
+
+    def _transition(self, st: _KeyState, new: str) -> None:
+        if st.state == new:
+            return
+        st.state = new
+        if self._metrics is not None:
+            self._metrics.inc(f"breaker.to_{new}")
+
+    def allow(self, key) -> bool:
+        """May a factorization attempt for `key` proceed?  Closed:
+        yes.  Open: no until the cooldown elapses, then one half-open
+        probe.  Half-open: only the single probe already admitted —
+        but a probe that never reported back (caller died, path that
+        neither succeeded nor failed) releases after another cooldown,
+        so a leaked probe can never permanently circuit-break a key."""
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None or st.state == "closed":
+                return True
+            now = self._clock()
+            if st.state == "open":
+                if now - st.opened_at < self.cooldown_s:
+                    return False
+                self._transition(st, "half_open")
+                st.probing = True
+                st.probe_at = now
+                return True
+            # half_open: one probe in flight at a time, with a
+            # staleness escape for probes that never resolved
+            if st.probing and now - st.probe_at < self.cooldown_s:
+                return False
+            st.probing = True
+            st.probe_at = now
+            return True
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            st = self._keys.pop(key, None)
+            if st is not None and st.state != "closed" \
+                    and self._metrics is not None:
+                self._metrics.inc("breaker.to_closed")
+
+    def record_failure(self, key) -> None:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = _KeyState()
+            st.failures += 1
+            if st.state == "half_open":
+                # the probe failed: re-open for another full cooldown
+                st.probing = False
+                st.opened_at = self._clock()
+                self._transition(st, "open")
+            elif st.state == "closed" and st.failures >= self.threshold:
+                st.opened_at = self._clock()
+                self._transition(st, "open")
+
+    def state(self, key) -> str:
+        with self._lock:
+            st = self._keys.get(key)
+            return st.state if st is not None else "closed"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            by_state: dict[str, int] = {}
+            for st in self._keys.values():
+                by_state[st.state] = by_state.get(st.state, 0) + 1
+            return {"tracked": len(self._keys), "by_state": by_state}

@@ -1,0 +1,186 @@
+"""Deterministic fault injection for the serve stack.
+
+Nothing in a solver exercises its failure paths by accident: a
+factorization that works never raises, factors that converge are never
+NaN, a flusher thread that's healthy never dies.  This module is the
+only way the repo breaks itself ON PURPOSE — a seeded, spec-driven
+chaos layer whose injection sites are compiled into the serve code
+(`factor_cache`, `batcher`, `store`) but cost one module-global `is
+None` check when off, so production paths pay nothing.
+
+Spec grammar (`SLU_CHAOS` or `install(spec)`):
+
+    site=prob[:param][,site=prob[:param]]...
+
+        factor_raise=0.3          30% of factorizations raise ChaosError
+        factor_nan=0.3            30% of factorizations return NaN factors
+        store_flip=1              every store read gets one bit flipped
+        flusher_raise=0.05        5% of flusher batches kill the flusher
+        latency=0.2:0.005         20% of dispatches sleep 5 ms
+
+Determinism: each site owns a `random.Random` seeded from
+(`SLU_CHAOS_SEED`, site name), so the same spec+seed replays the same
+failure sequence regardless of which other sites fire — the property
+that makes a chaos regression debuggable.  Per-site fired counters
+feed the CHAOS.jsonl record (tools/serve_bench.py --chaos).
+
+Sites are NAMED here (SITES) and validated at install: a typo'd site
+in a spec is an error, not silence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import threading
+import time
+
+SITES = ("factor_raise", "factor_nan", "store_flip", "flusher_raise",
+         "latency")
+
+
+def _stable_seed(seed: int, *legs) -> int:
+    """Process-independent integer seed from (seed, legs)."""
+    h = hashlib.sha256(
+        ("\x00".join([str(seed)] + [str(x) for x in legs])).encode())
+    return int.from_bytes(h.digest()[:8], "big")
+
+
+class ChaosError(RuntimeError):
+    """An injected failure (never raised by real solver code): test
+    assertions and loadgen accounting can tell engineered faults from
+    genuine bugs."""
+
+
+class ChaosPolicy:
+    """Parsed spec + per-site seeded RNGs and fired counters."""
+
+    def __init__(self, spec: str, seed: int = 0) -> None:
+        self.spec = spec
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._prob: dict[str, float] = {}
+        self._param: dict[str, float] = {}
+        self._rng: dict[str, random.Random] = {}
+        self._fired: dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, rest = part.partition("=")
+            name = name.strip()
+            if name not in SITES:
+                raise ValueError(
+                    f"unknown chaos site {name!r}; expected one of "
+                    f"{SITES}")
+            probs, _, param = rest.partition(":")
+            self._prob[name] = float(probs) if probs else 1.0
+            if param:
+                self._param[name] = float(param)
+            # site-local stream: firing order at one site never
+            # perturbs another site's sequence.  Seeded via a STABLE
+            # hash — str.__hash__ is PYTHONHASHSEED-randomized and
+            # would silently break cross-process replay
+            self._rng[name] = random.Random(_stable_seed(seed, name))
+            self._fired[name] = 0
+
+    def should(self, site: str) -> bool:
+        """One draw at `site`; counts a firing when it trips."""
+        with self._lock:
+            p = self._prob.get(site)
+            if p is None:
+                return False
+            if self._rng[site].random() >= p:
+                return False
+            self._fired[site] += 1
+            return True
+
+    def param(self, site: str, default: float = 0.0) -> float:
+        return self._param.get(site, default)
+
+    def fired(self) -> dict:
+        with self._lock:
+            return dict(self._fired)
+
+
+# the process-wide policy; None = chaos off (the only cost real code
+# ever pays is this pointer check)
+_POLICY: ChaosPolicy | None = None
+
+
+def install(spec: str, seed: int | None = None) -> ChaosPolicy:
+    global _POLICY
+    if seed is None:
+        seed = int(os.environ.get("SLU_CHAOS_SEED", "0") or "0")
+    _POLICY = ChaosPolicy(spec, seed=seed)
+    return _POLICY
+
+
+def install_from_env() -> ChaosPolicy | None:
+    spec = os.environ.get("SLU_CHAOS", "").strip()
+    return install(spec) if spec else None
+
+
+def uninstall() -> None:
+    global _POLICY
+    _POLICY = None
+
+
+def active() -> ChaosPolicy | None:
+    return _POLICY
+
+
+# -- injection-site helpers (all no-ops when chaos is off) -----------
+
+def should(site: str) -> bool:
+    p = _POLICY
+    return p is not None and p.should(site)
+
+
+def maybe_raise(site: str, msg: str) -> None:
+    if should(site):
+        raise ChaosError(f"[chaos:{site}] {msg}")
+
+
+def maybe_sleep(site: str, default_s: float = 0.005) -> None:
+    p = _POLICY
+    if p is not None and p.should(site):
+        time.sleep(p.param(site, default_s))
+
+
+def maybe_flip_bit(site: str, data: bytes) -> bytes:
+    """Flip one deterministic bit of `data` when `site` fires — the
+    persisted-entry-corruption fault the store's checksum must catch."""
+    p = _POLICY
+    if p is None or not data or not p.should(site):
+        return data
+    rng = random.Random(_stable_seed(p.seed, site, len(data)))
+    i = rng.randrange(len(data))
+    out = bytearray(data)
+    out[i] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+def maybe_poison_factors(site: str, lu) -> None:
+    """Overwrite the factorization's numeric factors with NaN when
+    `site` fires — the silently-wrong-answer fault the serve layer's
+    finite-validation gate (FactorPoisoned) must contain.  Mutates the
+    handle in place (host panels) or swaps device flats."""
+    if not should(site):
+        return
+    import numpy as np
+    if lu.backend == "host":
+        for side in (lu.host_lu.L, lu.host_lu.U,
+                     lu.host_lu.Linv, lu.host_lu.Uinv):
+            for p in side:
+                p[...] = np.nan
+        return
+    import jax.numpy as jnp
+    d = lu.device_lu
+    if hasattr(d, "panels"):
+        d.panels = [tuple(jnp.full_like(a, jnp.nan) for a in p)
+                    for p in d.panels]
+        return
+    for f in ("L_flat", "U_flat", "Li_flat", "Ui_flat"):
+        setattr(d, f, jnp.full_like(getattr(d, f), jnp.nan))

@@ -1,0 +1,39 @@
+"""Bounded retry with exponential backoff + deterministic jitter.
+
+The serve layer's unit of retriable work is a failed factorization:
+transient faults (OOM races, injected chaos, a flaky accelerator
+runtime) deserve a bounded number of re-attempts with growing spacing,
+while deterministic faults (singular matrix, shape errors) fail the
+same way every time and just cost the retries — which is why the
+policy is BOUNDED and the circuit breaker (breaker.py) sits behind it
+to stop a key that fails repeatedly from burning a full retry ladder
+per request.
+
+Jitter is seeded (same policy → same delay sequence) so chaos runs
+replay exactly; the classic thundering-herd argument for jitter still
+holds across processes because each replica seeds differently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """`attempts` TOTAL tries (1 = no retry); delay before retry k is
+    min(max_s, base_s·2^k)·(1 + jitter·u), u deterministic in [0,1)."""
+
+    attempts: int = 3
+    base_s: float = 0.05
+    max_s: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def delays(self):
+        """The attempts-1 sleep durations between tries."""
+        rng = random.Random(self.seed)
+        for k in range(max(0, self.attempts - 1)):
+            d = min(self.max_s, self.base_s * (2.0 ** k))
+            yield d * (1.0 + self.jitter * rng.random())

@@ -1,0 +1,332 @@
+"""Durable factor store: crash-safe persistence of factorizations.
+
+A factorization costs minutes at production scale while a solve costs
+milliseconds (SOLVE_LATENCY.jsonl) — so a replica restart that drops
+process memory is a multi-minute outage PER HOT KEY unless the factors
+survive on disk.  This module is the persistence tier under
+`serve/factor_cache.py` (`SLU_FT_STORE=dir`): write-through on every
+fresh factorization, read-through on every full-key miss, so a
+`kill -9`'d replica boots warm.
+
+Durability discipline:
+
+  * atomic rename — entries are written tmp+fsync+`os.replace`
+    (utils/io.atomic_write_bytes), so a crash mid-write leaves the old
+    entry (or nothing), never a torn file;
+  * ABFT-lite checksum — sha256 over the factor arrays' bytes, stored
+    in the payload and recomputed on load; a flipped bit anywhere in
+    the numeric payload (disk rot, truncation, chaos `store_flip`)
+    quarantines the entry instead of serving corrupted factors;
+  * format version — an entry written by an incompatible layout is
+    quarantined, not misinterpreted;
+  * schedule-layout fingerprint — device flats are only valid against
+    the slab layout the CURRENT env knobs produce (SLU_LEVEL_MERGE
+    etc. move offsets); a mismatch quarantines rather than serving
+    factors misaligned against a rebuilt schedule.
+
+Quarantine renames the file to `<entry>.quarantined` — the evidence
+survives for forensics, the load path never sees it again, and the
+next factorization's write-through replaces it.
+
+What is stored: the plan (FactorPlan strips its jit caches via
+__getstate__), effective options, the original matrix (refinement
+residuals need A), and the factor arrays converted to host numpy.
+Device handles are rebuilt on load from the plan's schedule; the
+`dist` backend's mesh-sharded factors are not persistable and are
+skipped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+
+import numpy as np
+
+from ..models.gssvx import (LUFactorization, factor_arrays,
+                            factors_finite)
+from ..sparse import CSRMatrix
+from ..utils.io import atomic_write_bytes
+from ..utils.stats import Stats
+from . import chaos
+
+FORMAT_VERSION = 1
+SUFFIX = ".slufactor"
+# file framing: magic+version, then sha256 over the pickle blob, then
+# the blob.  The outer digest catches a flipped bit ANYWHERE in the
+# entry (plan, matrix, metadata — not just factor arrays); the inner
+# per-array checksum (payload["checksum"]) is the ABFT-lite layer that
+# additionally survives the rebuild (it is recomputed from the
+# reconstructed handle, so a deserialization bug that mangles arrays
+# is caught even when the bytes on disk were pristine).
+_MAGIC = b"SLUF\x01"
+
+
+class StoreCorrupt(RuntimeError):
+    """A persisted entry failed verification (version, key echo,
+    checksum, layout); the load path quarantines and re-factors."""
+
+
+def checksum_arrays(arrays) -> str:
+    """sha256 over the factor arrays' raw bytes, in order — the
+    ABFT-lite content signature."""
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def entry_name(key) -> str:
+    """Filesystem name for a cache key: hash of all three key legs
+    (pattern, values, options) — collision-safe and path-safe."""
+    h = hashlib.sha256()
+    h.update(key.pattern.encode())
+    h.update(b"\x00")
+    h.update(key.values.encode())
+    h.update(b"\x00")
+    h.update(repr(key.options).encode())
+    return h.hexdigest()[:40] + SUFFIX
+
+
+def _device_layout(lu: LUFactorization):
+    """Slab-layout fingerprint of a device handle's schedule; None for
+    host factors (panel layout is env-independent)."""
+    d = lu.device_lu
+    if d is None:
+        return None
+    s = d.schedule
+    return (int(s.L_total), int(s.U_total), int(s.Li_total),
+            int(s.Ui_total), int(getattr(s, "upd_pad", 0)),
+            len(s.groups))
+
+
+class FactorStore:
+    """Directory-backed store of LUFactorization payloads.
+
+    Thread-safe; counters go to the injected metrics object
+    (duck-typed `.inc`) under `factor_store.*`."""
+
+    def __init__(self, root: str, metrics=None) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def path_for(self, key) -> str:
+        return os.path.join(self.root, entry_name(key))
+
+    def contains(self, key) -> bool:
+        return os.path.exists(self.path_for(key))
+
+    def entries(self) -> list[str]:
+        return sorted(p for p in os.listdir(self.root)
+                      if p.endswith(SUFFIX))
+
+    def quarantined(self) -> list[str]:
+        return sorted(p for p in os.listdir(self.root)
+                      if p.endswith(".quarantined"))
+
+    # -- write path ----------------------------------------------------
+
+    def save(self, key, lu: LUFactorization) -> str | None:
+        """Persist `lu` under `key` atomically; returns the path, or
+        None for non-persistable handles (dist backend)."""
+        if lu.backend == "dist":
+            self._inc("factor_store.skipped_dist")
+            return None
+        arrays = factor_arrays(lu)
+        if lu.backend == "host":
+            kind = "host"
+        elif hasattr(lu.device_lu, "panels"):
+            kind = "staged"
+        else:
+            kind = "device"
+        a = lu.a
+        payload = {
+            "format": FORMAT_VERSION,
+            "key": key,
+            "backend": lu.backend,
+            "kind": kind,
+            "options": lu.effective_options,
+            "plan": lu.plan,
+            "a": (None if a is None else
+                  (a.m, a.n, np.asarray(a.indptr),
+                   np.asarray(a.indices), np.asarray(a.data))),
+            "arrays": [np.ascontiguousarray(x) for x in arrays],
+            "dtype": (str(np.dtype(lu.device_lu.dtype))
+                      if lu.device_lu is not None else None),
+            "tiny_pivots": int(getattr(
+                lu.host_lu if lu.backend == "host" else lu.device_lu,
+                "tiny_pivots", 0)),
+            "layout": _device_layout(lu),
+            "checksum": checksum_arrays(arrays),
+        }
+        blob = pickle.dumps(payload, protocol=4)
+        framed = _MAGIC + hashlib.sha256(blob).digest() + blob
+        atomic_write_bytes(self.path_for(key), framed)
+        self._inc("factor_store.saves")
+        return self.path_for(key)
+
+    # -- read path -----------------------------------------------------
+
+    def load(self, key) -> LUFactorization | None:
+        """Read-through lookup: a verified handle, or None (absent OR
+        quarantined — the caller re-factors either way)."""
+        path = self.path_for(key)
+        if not os.path.exists(path):
+            self._inc("factor_store.misses")
+            return None
+        loaded = self._load_path(path, expect_key=key)
+        if loaded is None:
+            return None
+        self._inc("factor_store.hits")
+        return loaded[1]
+
+    def _load_path(self, path: str, expect_key=None):
+        """Read + verify one entry: (key, handle), or None (entry
+        vanished concurrently, or failed verification → quarantined).
+        NOTHING is unpickled before the sha256 frame digest passes —
+        pickle never sees unverified bytes."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            # quarantined/removed by a concurrent loader between the
+            # caller's existence check and our open: a miss, not an
+            # error — the caller re-factors
+            self._inc("factor_store.misses")
+            return None
+        # chaos site: one flipped bit in the persisted entry — the
+        # fault the checksum exists to catch
+        data = chaos.maybe_flip_bit("store_flip", data)
+        try:
+            if data[:len(_MAGIC)] != _MAGIC:
+                raise StoreCorrupt("bad magic / truncated entry")
+            digest = data[len(_MAGIC):len(_MAGIC) + 32]
+            blob = data[len(_MAGIC) + 32:]
+            if hashlib.sha256(blob).digest() != digest:
+                raise StoreCorrupt("entry digest mismatch")
+            payload = pickle.loads(blob)
+            if payload.get("format") != FORMAT_VERSION:
+                raise StoreCorrupt(
+                    f"format {payload.get('format')} != "
+                    f"{FORMAT_VERSION}")
+            if expect_key is not None and payload["key"] != expect_key:
+                raise StoreCorrupt("key echo mismatch")
+            lu = self._rebuild(payload)
+            if checksum_arrays(factor_arrays(lu)) \
+                    != payload["checksum"]:
+                raise StoreCorrupt("factor checksum mismatch")
+            if not factors_finite(lu):
+                raise StoreCorrupt("persisted factors non-finite")
+            return payload["key"], lu
+        except Exception as e:
+            self.quarantine(path, reason=repr(e))
+            return None
+
+    def _rebuild(self, payload) -> LUFactorization:
+        plan = payload["plan"]
+        a = payload["a"]
+        mat = (None if a is None else
+               CSRMatrix(a[0], a[1], a[2], a[3], a[4]))
+        arrays = payload["arrays"]
+        kind = payload["kind"]
+        st = Stats()
+        if kind == "host":
+            from ..ops.ref_multifrontal import HostLU
+            ns = plan.frontal.nsuper
+            if len(arrays) != 4 * ns:
+                raise StoreCorrupt(
+                    f"host payload has {len(arrays)} panels for "
+                    f"{ns} supernodes")
+            chunks = [arrays[i * ns:(i + 1) * ns] for i in range(4)]
+            host_lu = HostLU(plan=plan, L=chunks[0], U=chunks[1],
+                             Linv=chunks[2], Uinv=chunks[3],
+                             tiny_pivots=payload["tiny_pivots"])
+            lu = LUFactorization(plan=plan, backend="host",
+                                 host_lu=host_lu, a=mat, stats=st)
+        else:
+            import jax.numpy as jnp
+            from ..ops import batched
+            sched = batched.get_schedule(plan, 1)
+            dtype = np.dtype(payload["dtype"])
+            if kind == "staged":
+                if len(arrays) % 4:
+                    raise StoreCorrupt("staged payload not 4-aligned")
+                panels = [tuple(jnp.asarray(x)
+                                for x in arrays[i:i + 4])
+                          for i in range(0, len(arrays), 4)]
+                dev = batched.StagedLU(
+                    plan=plan, schedule=sched, dtype=dtype,
+                    panels=panels,
+                    tiny_pivots=payload["tiny_pivots"])
+            else:
+                if len(arrays) != 4:
+                    raise StoreCorrupt("device payload needs 4 flats")
+                dev = batched.DeviceLU(
+                    plan=plan, schedule=sched, dtype=dtype,
+                    L_flat=jnp.asarray(arrays[0]),
+                    U_flat=jnp.asarray(arrays[1]),
+                    Li_flat=jnp.asarray(arrays[2]),
+                    Ui_flat=jnp.asarray(arrays[3]),
+                    tiny_pivots=payload["tiny_pivots"])
+            lu = LUFactorization(plan=plan, backend="jax",
+                                 device_lu=dev, a=mat, stats=st)
+            if payload.get("layout") is not None \
+                    and _device_layout(lu) != payload["layout"]:
+                raise StoreCorrupt(
+                    "schedule layout changed since save (env knobs "
+                    "moved slab offsets); refusing misaligned factors")
+        lu.options = payload["options"]
+        st.lu_nnz = plan.lu_nnz()
+        return lu
+
+    # -- quarantine / warm boot ---------------------------------------
+
+    def quarantine(self, path: str, reason: str = "") -> None:
+        """Move a failed entry aside so it is never loaded again; the
+        loudest store event there is (a quarantine means bits rotted
+        or a writer lied) — counted and traced."""
+        from .. import obs
+        with self._lock:
+            try:
+                os.replace(path, path + ".quarantined")
+            except OSError:
+                pass
+        self._inc("factor_store.quarantined")
+        obs.instant("resilience.store_quarantine", cat="resilience",
+                    args={"entry": os.path.basename(path),
+                          "reason": reason[:200]})
+
+    def warm_boot(self, cache) -> int:
+        """Load every verified entry into `cache` (FactorCache) — the
+        explicit eager variant of read-through for a fresh replica
+        that wants its working set resident before traffic."""
+        n = 0
+        for name in self.entries():
+            # one verified read per entry; the key comes from the
+            # verified payload itself (never from unverified bytes)
+            loaded = self._load_path(os.path.join(self.root, name))
+            if loaded is not None:
+                key, lu = loaded
+                cache.put(key, lu)
+                self._inc("factor_store.hits")
+                n += 1
+        return n
+
+    def stats(self) -> dict:
+        return {"entries": len(self.entries()),
+                "quarantined": len(self.quarantined()),
+                "root": self.root}
+
+
+def store_from_env(metrics=None) -> FactorStore | None:
+    """The `SLU_FT_STORE=dir` hookup used by FactorCache."""
+    d = os.environ.get("SLU_FT_STORE", "").strip()
+    return FactorStore(d, metrics=metrics) if d else None

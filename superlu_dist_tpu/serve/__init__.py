@@ -9,6 +9,13 @@ deadlines (service.py), structured metrics (metrics.py), and a
 seeded closed-loop load generator (loadgen.py).  Driven end to end by
 tools/serve_bench.py, which appends records to SERVE_LATENCY.jsonl.
 
+Failure containment rides the sibling resilience/ package: the
+durable factor store (ServeConfig.store_dir / SLU_FT_STORE), per-key
+circuit breaker + bounded retry around cold factorizations, explicit
+FlusherDead futures when a batcher thread dies, and degraded-mode
+serving off stale factors (DegradedResult) — exercised by
+`tools/serve_bench.py --chaos` (CHAOS.jsonl).
+
 Quickstart:
 
     from superlu_dist_tpu.serve import ServeConfig, SolveService
@@ -18,8 +25,9 @@ Quickstart:
 """
 
 from .batcher import BUCKET_LADDER, MicroBatcher, bucket_for
-from .errors import (DeadlineExceeded, FactorMissError, ServeError,
-                     ServeRejected)
+from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
+                     FactorPoisoned, FlusherDead, ServeError,
+                     ServeRejected, factor_cost_hint)
 from .factor_cache import (CacheKey, FactorCache, matrix_key,
                            pattern_fingerprint, values_fingerprint)
 from .loadgen import run_load
@@ -31,8 +39,11 @@ __all__ = [
     "CacheKey",
     "Counter",
     "DeadlineExceeded",
+    "DegradedResult",
     "FactorCache",
     "FactorMissError",
+    "FactorPoisoned",
+    "FlusherDead",
     "Histogram",
     "Metrics",
     "MicroBatcher",
@@ -41,6 +52,7 @@ __all__ = [
     "ServeRejected",
     "SolveService",
     "bucket_for",
+    "factor_cost_hint",
     "matrix_key",
     "pattern_fingerprint",
     "run_load",

@@ -32,7 +32,8 @@ import numpy as np
 
 from .. import obs
 from ..models.gssvx import LUFactorization, solve, solve_rhs_dtype
-from .errors import DeadlineExceeded, ServeError
+from ..resilience import chaos
+from .errors import DeadlineExceeded, FlusherDead, ServeError
 from .metrics import Metrics
 
 # nrhs bucket ladder: the only column counts the jitted solver ever
@@ -98,11 +99,25 @@ class MicroBatcher:
         self._cond = threading.Condition(self._lock)
         self._pending: list[_Request] = []
         self._closed = False
+        # set to the fatal exception if the flusher thread ever dies;
+        # submits then fail fast with FlusherDead instead of queueing
+        # into a thread that will never flush them
+        self._dead: BaseException | None = None
+        # the batch popped off _pending but not yet resolved — the
+        # death handler must fail these too (they are invisible to
+        # _pending once claimed)
+        self._inflight_batch: list[_Request] = []
         self.batches_dispatched = 0
         self._flusher = threading.Thread(target=self._run,
                                          name="slu-serve-flusher",
                                          daemon=True)
         self._flusher.start()
+
+    @property
+    def dead(self) -> BaseException | None:
+        """The exception that killed the flusher thread, or None while
+        it is healthy — the service's replace-dead-batcher probe."""
+        return self._dead
 
     # -- client side ---------------------------------------------------
 
@@ -131,6 +146,13 @@ class MicroBatcher:
                 # ServeError so the service can map a retired batcher
                 # (concurrent eviction) to its cold-key contract
                 raise ServeError("batcher is closed")
+            if self._dead is not None or not self._flusher.is_alive():
+                # watchdog: a dead flusher means this queue will never
+                # drain — fail fast instead of hanging the caller (the
+                # service replaces the batcher on the next request)
+                raise FlusherDead(
+                    f"flusher thread is dead "
+                    f"({self._dead!r}); resubmit")
             self._pending.append(req)
             self._cond.notify()
         return req.future
@@ -156,11 +178,55 @@ class MicroBatcher:
                 for r in pending:
                     r.future.cancel()
             self._cond.notify()
-        self._flusher.join()
+        if threading.current_thread() is not self._flusher:
+            # a dead batcher may be retired FROM its own flusher
+            # thread (the containment handler's future callbacks run
+            # there, and one of them may rebuild the batcher via the
+            # service); a self-join would raise — the thread is
+            # exiting anyway
+            self._flusher.join()
 
     # -- flusher -------------------------------------------------------
 
     def _run(self) -> None:
+        # containment wrapper: the loop body must never be able to
+        # strand queued futures by dying silently.  Any escape —
+        # a genuine bug outside _dispatch's own solve try, or the
+        # chaos flusher_raise site — fails every pending AND claimed
+        # request with an explicit FlusherDead, so callers get an
+        # error, never a hang (tools/serve_bench.py --chaos gates on
+        # exactly this).
+        try:
+            self._run_loop()
+        except BaseException as e:   # noqa: BLE001 — containment
+            self._flusher_died(e)
+
+    def _flusher_died(self, e: BaseException) -> None:
+        with self._cond:
+            self._dead = e
+            victims = self._pending + self._inflight_batch
+            self._pending = []
+            self._inflight_batch = []
+            self._cond.notify_all()
+        self.metrics.inc("batcher.flusher_died")
+        obs.instant("serve.flusher_died", cat="serve",
+                    args={"error": repr(e), "stranded": len(victims)})
+        err = FlusherDead(f"flusher thread died: {e!r}")
+        err.__cause__ = e
+        for r in victims:
+            # a claimed request is already running (the handshake
+            # below then raises and is swallowed); a queued one needs
+            # it first.  Either way the future must RESOLVE.
+            try:
+                r.future.set_running_or_notify_cancel()
+            except RuntimeError:
+                pass
+            try:
+                r.future.set_exception(err)
+            except Exception:
+                pass    # already resolved (cancelled / late race)
+
+    def _run_loop(self) -> None:
         max_bucket = self.ladder[-1]
         while True:
             with self._cond:
@@ -191,7 +257,17 @@ class MicroBatcher:
                     self._cond.wait(timeout=remaining)
                 batch = self._pending[:max_bucket]
                 del self._pending[:len(batch)]
+                # claimed but unresolved: visible to _flusher_died
+                self._inflight_batch = batch
+            # chaos site: the flusher dies holding a claimed batch —
+            # the worst-placed crash; containment must fail these
+            # futures explicitly (no-op when chaos is off)
+            chaos.maybe_raise("flusher_raise",
+                              f"flusher killed holding {len(batch)} "
+                              "requests")
             self._dispatch(batch)
+            with self._cond:
+                self._inflight_batch = []
 
     def _dispatch(self, batch: list[_Request]) -> None:
         now = time.monotonic()
@@ -224,6 +300,8 @@ class MicroBatcher:
         self.metrics.observe("serve.batch_occupancy", len(live) / k)
         self.metrics.inc("batcher.requests_solved", len(live))
         t1 = time.monotonic()
+        # chaos site: artificial dispatch latency (deadline storms)
+        chaos.maybe_sleep("latency")
         try:
             with obs.span("serve.batch_solve", cat="serve",
                           args={"nrhs": k,

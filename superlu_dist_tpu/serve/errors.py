@@ -3,10 +3,20 @@
 Every way a request can fail without being a solver bug is an explicit
 exception type, so callers (and the load generator's status taxonomy)
 can tell capacity pushback from deadline economics from cold-cache
-policy.  All derive from ServeError for blanket handling.
+policy from contained faults.  All derive from ServeError for blanket
+handling.  The one NON-error in this module is `DegradedResult`: the
+marker type stamped on solutions served through degraded mode
+(service.py) — still a correct answer behind the berr guard, but one
+the caller deserves to know came off stale factors.
 """
 
 from __future__ import annotations
+
+import functools
+import json
+import os
+
+import numpy as np
 
 
 class ServeError(RuntimeError):
@@ -28,5 +38,65 @@ class DeadlineExceeded(ServeError):
 
 class FactorMissError(ServeError):
     """Factor-cache miss under the fail-fast policy: this service is
-    configured not to pay a factorization inline (they cost ~500 s at
-    n=27k); prefactor() the key or use miss_policy='factor'."""
+    configured not to pay a factorization inline (they cost minutes at
+    production scale — `factor_cost_hint()` reads the measured figure
+    from SOLVE_LATENCY.jsonl so this text can't drift from the
+    trajectory); prefactor() the key or use miss_policy='factor'."""
+
+
+class FactorPoisoned(ServeError):
+    """The key's factorization cannot be served: it produced
+    non-finite (NaN/Inf) factors — which GESP would otherwise turn
+    into silently-wrong solves, there being no runtime pivoting to
+    trip on them — or it failed repeatedly and the per-key circuit
+    breaker is open (resilience/breaker.py).  Costs the caller one
+    immediate error, never a factorization-length retry."""
+
+
+class FlusherDead(ServeError):
+    """A micro-batcher's flusher thread died (crashed mid-flight or
+    was chaos-killed); its queued futures were failed with this
+    instead of hanging forever, and the service replaces the batcher
+    on the next request for the key."""
+
+
+class DegradedResult(np.ndarray):
+    """Marker subclass stamped on solutions served in DEGRADED mode:
+    a refactorization failed (or the key is circuit-broken) and the
+    service solved through resident stale/pattern-tier factors with
+    refinement against the fresh matrix, behind the standard berr
+    guard.  Numerically a normal ndarray (`isinstance(x,
+    DegradedResult)` is the stamp; `np.asarray(x)` strips it) — the
+    honest alternative to an outage, never a silent substitute for a
+    healthy solve."""
+
+
+@functools.lru_cache(maxsize=1)
+def factor_cost_hint() -> str:
+    """Human-readable cold-factorization cost for error messages —
+    centralized so the figure tracks the measured trajectory: reads
+    the latest `t_factor_s` record from SOLVE_LATENCY.jsonl at the
+    repo root, falling back to \"minutes\" when no record exists."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "SOLVE_LATENCY.jsonl")
+    last_t, last_desc = None, ""
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                t = rec.get("t_factor_s")
+                if t:
+                    last_t = float(t)
+                    last_desc = str(rec.get("desc", ""))
+    except OSError:
+        pass
+    if last_t is None:
+        return "minutes at production scale"
+    n = ""
+    if "n=" in last_desc:
+        n = f" ({last_desc[last_desc.index('n='):].split()[0]})"
+    return f"~{last_t:.0f} s measured{n}"

@@ -42,6 +42,14 @@ pattern_hits / evictions / single_flight_waits / bytes_resident.
 Capacity is a byte bound over `query_space(lu)["held_bytes"]` —
 factors dominate (the n=27k f32 example holds ~GBs); plans ride along
 uncounted in the pattern tier with a separate entry bound.
+
+Resilience tier (resilience/).  With a FactorStore attached
+(`SLU_FT_STORE=dir`) every fresh factorization is written through to
+disk (atomic rename + checksum) and every full-key miss reads through
+it — a `kill -9`'d replica boots warm, and corrupted entries are
+quarantined, never served.  The lead factorization is wrapped in a
+per-key circuit breaker and a bounded retry policy, and NaN/Inf
+factors raise FactorPoisoned instead of entering the cache.
 """
 
 from __future__ import annotations
@@ -56,11 +64,13 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..models.gssvx import (LUFactorization, effective_factor_dtype,
-                            factorize, query_space)
+                            factorize, factors_finite, query_space)
 from ..options import Options
 from ..plan.plan import plan_factorization
+from ..resilience import chaos
+from ..resilience.store import store_from_env
 from ..sparse import CSRMatrix
-from .errors import DeadlineExceeded
+from .errors import DeadlineExceeded, FactorPoisoned
 from .metrics import Metrics
 
 
@@ -129,12 +139,37 @@ class FactorCache:
                  backend: str = "auto",
                  metrics: Metrics | None = None,
                  factorize_fn: Callable | None = None,
-                 on_evict: Callable | None = None) -> None:
+                 on_evict: Callable | None = None,
+                 store=None,
+                 breaker=None,
+                 retry=None,
+                 validate_factors: bool = True) -> None:
         self.capacity_bytes = capacity_bytes
         self.max_plans = max_plans
         self.backend = backend
         self.metrics = metrics or Metrics()
         self._factorize_fn = factorize_fn or self._default_factorize
+        # durable persistence tier (resilience/store.py): read-through
+        # on full-key misses, write-through on fresh factorizations —
+        # a restarted replica boots warm.  Default from SLU_FT_STORE.
+        self.store = store if store is not None \
+            else store_from_env(metrics=self.metrics)
+        if self.store is not None and self.store._metrics is None:
+            # adopt an explicitly-passed store into this cache's
+            # metrics so its saves/hits/quarantines are observable
+            self.store._metrics = self.metrics
+        # per-key circuit breaker + bounded retry (resilience/): the
+        # containment pair around _acquire_factors.  Both default off
+        # for direct cache users; SolveService wires them from
+        # ServeConfig.
+        self.breaker = breaker
+        self.retry = retry
+        # finite-validation gate: NaN/Inf factors raise FactorPoisoned
+        # instead of entering the cache (GESP has no runtime pivoting
+        # to catch them later — they would solve to silent garbage).
+        # One O(factor bytes) host pass per factorization, noise next
+        # to the factorization itself.
+        self.validate_factors = validate_factors
         # on_evict(key, lu) fires AFTER the cache lock is released for
         # every LRU eviction — the service uses it to drop the evicted
         # key's batchers, so eviction actually releases the factors
@@ -175,6 +210,13 @@ class FactorCache:
                 m.counter("factor_cache.single_flight_waits"),
             "factorizations": m.counter("factor_cache.factorizations"),
             "hit_rate": (hits / total) if total else 0.0,
+            # resilience tier (resilience/store.py, breaker.py)
+            "store_hits": m.counter("factor_cache.store_hits"),
+            "store_saves": m.counter("factor_store.saves"),
+            "store_quarantined": m.counter("factor_store.quarantined"),
+            "factor_retries": m.counter("factor_cache.factor_retries"),
+            "breaker_rejected":
+                m.counter("factor_cache.breaker_rejected"),
         }
 
     # -- core ----------------------------------------------------------
@@ -277,17 +319,16 @@ class FactorCache:
             return self._lead_factorization(a, options, key, flight)
 
     def _lead_factorization(self, a, options, key, flight):
+        # CONTAINMENT CONTRACT (pinned by tests/test_resilience.py):
+        # whatever _acquire_factors raises is (a) recorded on the
+        # flight so every waiting follower wakes with the SAME
+        # exception, and (b) the in-flight entry is removed in the
+        # finally — so the N+1-th request elects a fresh leader and
+        # retries cleanly instead of hanging on a dead flight or
+        # finding a permanently-poisoned key slot.
         self.metrics.inc("factor_cache.misses")
         try:
-            plan = None
-            with self._lock:
-                plan = self._plans.get(key.pattern_key)
-                if plan is not None:
-                    self._plans.move_to_end(key.pattern_key)
-            if plan is not None:
-                self.metrics.inc("factor_cache.pattern_hits")
-            self.metrics.inc("factor_cache.factorizations")
-            lu = self._factorize_fn(a, options, plan)
+            lu = self._acquire_factors(a, options, key)
             self.put(key, lu)
             flight.lu = lu
             return lu
@@ -298,6 +339,95 @@ class FactorCache:
             with self._lock:
                 self._inflight.pop(key, None)
             flight.event.set()
+
+    def _acquire_factors(self, a, options, key) -> LUFactorization:
+        """Factors for a confirmed miss: breaker gate → store
+        read-through → factorize (bounded retry, chaos sites, finite
+        validation) → store write-through."""
+        if self.breaker is not None and not self.breaker.allow(key):
+            self.metrics.inc("factor_cache.breaker_rejected")
+            raise FactorPoisoned(
+                f"key circuit-broken ({self.breaker.state(key)}): "
+                "its factorization failed repeatedly; retry after "
+                "the cooldown")
+        if self.store is not None:
+            lu = self.store.load(key)
+            if lu is not None:
+                if factors_finite(lu):
+                    self.metrics.inc("factor_cache.store_hits")
+                    if self.breaker is not None:
+                        # a verified store hit resolves the key (and
+                        # releases a half-open probe admitted above)
+                        self.breaker.record_success(key)
+                    return lu
+                # a verified-checksum entry with NaN factors means a
+                # pre-validation writer; quarantine and re-factor
+                self.store.quarantine(self.store.path_for(key),
+                                      reason="non-finite on load")
+        plan = None
+        with self._lock:
+            plan = self._plans.get(key.pattern_key)
+            if plan is not None:
+                self._plans.move_to_end(key.pattern_key)
+        if plan is not None:
+            self.metrics.inc("factor_cache.pattern_hits")
+        delays = list(self.retry.delays()) if self.retry is not None \
+            else []
+        attempt = 0
+        while True:
+            try:
+                chaos.maybe_raise("factor_raise",
+                                  f"factorization killed (pattern "
+                                  f"{key.pattern[:12]})")
+                self.metrics.inc("factor_cache.factorizations")
+                lu = self._factorize_fn(a, options, plan)
+                chaos.maybe_poison_factors("factor_nan", lu)
+                if self.validate_factors and not factors_finite(lu):
+                    raise FactorPoisoned(
+                        "factorization produced non-finite factors "
+                        "(overflow/NaN at this dtype); not cached, "
+                        "not served")
+                break
+            except DeadlineExceeded:
+                raise                      # deadlines are not faults
+            except Exception:
+                if attempt >= len(delays):
+                    # breaker counts REQUESTS that failed (retries
+                    # exhausted), not every attempt — one request's
+                    # own retry ladder must not open the circuit
+                    if self.breaker is not None:
+                        self.breaker.record_failure(key)
+                    raise
+                self.metrics.inc("factor_cache.factor_retries")
+                time.sleep(delays[attempt])
+                attempt += 1
+        if self.breaker is not None:
+            self.breaker.record_success(key)
+        if self.store is not None:
+            try:
+                self.store.save(key, lu)
+            except Exception:
+                # persistence is an availability feature; its failure
+                # (disk full, perms) must not fail the request that
+                # just paid a real factorization
+                self.metrics.inc("factor_store.save_errors")
+        return lu
+
+    def resident_stale(self, key: CacheKey
+                       ) -> Optional[tuple]:
+        """Most-recently-used RESIDENT entry sharing `key`'s pattern
+        key (same structure and factor options, different values) —
+        the degraded-mode fallback when `key` itself cannot be
+        factored: its factors are a stale-but-structurally-identical
+        preconditioner the service refines against the fresh values
+        (service.py).  Returns (stale key, handle) or None.  Does not
+        touch LRU order or hit/miss counters — a degraded probe is a
+        policy question, not a use."""
+        with self._lock:
+            for ek in reversed(self._entries):
+                if ek != key and ek.pattern_key == key.pattern_key:
+                    return ek, self._entries[ek].lu
+        return None
 
     def _default_factorize(self, a, options, plan):
         if plan is None:

@@ -23,7 +23,9 @@ import time
 
 import numpy as np
 
-from .errors import DeadlineExceeded, FactorMissError, ServeRejected
+from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
+                     FactorPoisoned, FlusherDead, ServeError,
+                     ServeRejected)
 from .service import SolveService
 
 
@@ -33,13 +35,20 @@ def run_load(service: SolveService, matrices, *,
              hot_fraction: float = 1.0,
              deadline_s: float | None = None,
              options=None,
-             seed: int = 0) -> dict:
+             seed: int = 0,
+             join_timeout_s: float | None = None) -> dict:
     """Drive `requests` total solves through `service` from
     `concurrency` closed-loop workers; returns the report dict.
 
     `matrices` is a list of (CSRMatrix | CacheKey); index 0 is the hot
     key.  Workers split the request count evenly (remainder to the
-    first workers)."""
+    first workers).
+
+    `join_timeout_s` bounds the wait for workers: the report's
+    `unresolved` field counts requests that never produced a status —
+    the chaos gate's zero-hangs pin (a hung future means a worker
+    never returns; without the bound the hang would eat the caller).
+    None (the default) keeps unbounded joins for cooperative loads."""
     matrices = list(matrices)
     n_workers = min(concurrency, requests)
     counts = [requests // n_workers] * n_workers
@@ -74,14 +83,27 @@ def run_load(service: SolveService, matrices, *,
             try:
                 x = service.solve(matrices[mi], b, options=options,
                                   deadline_s=deadline_s)
-                status = ("ok" if np.all(np.isfinite(x))
-                          else "nonfinite")
+                if not np.all(np.isfinite(x)):
+                    # a non-finite "success" is the one outcome the
+                    # chaos gate forbids outright — never fold it into
+                    # ok OR degraded
+                    status = "nonfinite"
+                elif isinstance(x, DegradedResult):
+                    status = "degraded"
+                else:
+                    status = "ok"
             except ServeRejected:
                 status = "rejected"
             except DeadlineExceeded:
                 status = "deadline"
             except FactorMissError:
                 status = "miss_failfast"
+            except FactorPoisoned:
+                status = "poisoned"
+            except FlusherDead:
+                status = "flusher_dead"
+            except ServeError:
+                status = "serve_error"
             except Exception:
                 # a worker must never die silently: an unexpected
                 # error (solver failure re-raised from a batch future,
@@ -96,8 +118,13 @@ def run_load(service: SolveService, matrices, *,
     t_start = time.monotonic()
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    if join_timeout_s is None:
+        for t in threads:
+            t.join()
+    else:
+        join_deadline = t_start + join_timeout_s
+        for t in threads:
+            t.join(max(0.0, join_deadline - time.monotonic()))
     wall_s = time.monotonic() - t_start
 
     by_status: dict[str, int] = {}
@@ -111,6 +138,10 @@ def run_load(service: SolveService, matrices, *,
         "hot_fraction": hot_fraction,
         "wall_s": wall_s,
         "by_status": by_status,
+        # requests that never produced ANY status: zero unless a
+        # worker hung past join_timeout_s — the chaos gate fails on
+        # a single one
+        "unresolved": requests - len(results),
         "solves_per_s": (len(ok_lat) / wall_s) if wall_s > 0 else 0.0,
         "metrics": service.metrics.snapshot(),
     }

@@ -17,8 +17,20 @@ caller can rely on:
 Cold keys follow `miss_policy`: "factor" pays the factorization once
 (single-flight, so a thundering herd on one key does one
 factorization's worth of work); "failfast" raises FactorMissError so
-interactive traffic never blocks ~500 s behind a cold tenant — the
-operator prefactors keys out of band via `prefactor()`.
+interactive traffic never blocks minutes behind a cold tenant (the
+measured figure lives in errors.factor_cost_hint, sourced from
+SOLVE_LATENCY.jsonl) — the operator prefactors keys out of band via
+`prefactor()`.
+
+Failure containment (resilience/): factorization failures are retried
+(bounded backoff), repeatedly-failing keys are circuit-broken
+(FactorPoisoned, one immediate error instead of a factorization-length
+retry per request), dead batcher flushers fail their futures with
+FlusherDead and are replaced on the next request — and when a
+refactorization fails while a stale same-pattern factorization is
+resident, DEGRADED MODE solves through the stale factors with
+refinement against the fresh matrix behind the standard berr guard,
+returning a `DegradedResult`-stamped answer instead of an outage.
 
 Everything is observable through a shared Metrics registry; the
 snapshot feeds SERVE_LATENCY.jsonl (tools/serve_bench.py).
@@ -38,10 +50,14 @@ import numpy as np
 
 from ..models.gssvx import LUFactorization, solve
 from ..options import Options, merge_solve_options, solve_options_key
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.retry import RetryPolicy
+from ..resilience.store import FactorStore
 from ..sparse import CSRMatrix
 from .batcher import BUCKET_LADDER, MicroBatcher
-from .errors import (DeadlineExceeded, FactorMissError, ServeError,
-                     ServeRejected)
+from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
+                     FlusherDead, ServeError, ServeRejected,
+                     factor_cost_hint)
 from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
@@ -89,6 +105,27 @@ def _merged_solve_fn(options: Options, metrics: Metrics | None = None,
     return fn
 
 
+def _mark_degraded(fut: Future) -> Future:
+    """A future resolving to the same outcome as `fut`, with a
+    successful result re-viewed as DegradedResult — the stamp a caller
+    checks with isinstance (loadgen counts it as its own status)."""
+    out: Future = Future()
+
+    def _done(f: Future) -> None:
+        if f.cancelled():
+            out.cancel()
+            out.set_running_or_notify_cancel()
+            return
+        e = f.exception()
+        if e is not None:
+            out.set_exception(e)
+        else:
+            out.set_result(np.asarray(f.result()).view(DegradedResult))
+
+    fut.add_done_callback(_done)
+    return out
+
+
 @dataclasses.dataclass
 class ServeConfig:
     """Service policy knobs (the serving analog of Options)."""
@@ -115,6 +152,25 @@ class ServeConfig:
     dtype_tiers: bool = dataclasses.field(
         default_factory=lambda: bool(int(
             os.environ.get("SLU_PREC_TIERS", "0") or "0")))
+    # --- resilience (resilience/) ---
+    # durable factor store directory; None falls through to the
+    # cache's own SLU_FT_STORE env default
+    store_dir: str | None = None
+    # extra factorization attempts after the first (bounded
+    # exponential backoff + deterministic jitter); 0 = no retry
+    factor_retries: int = 0
+    retry_base_s: float = 0.05
+    # per-key circuit breaker: this many lead-factorization failures
+    # open the circuit for cooldown_s (then one half-open probe);
+    # 0 disables
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+    # degraded-mode serving: when a refactorization fails (or the key
+    # is circuit-broken) but a stale same-pattern factorization is
+    # resident, solve through it with refinement against the FRESH
+    # matrix behind the berr guard and stamp the result DegradedResult
+    # — instead of returning an outage
+    degraded: bool = True
 
 
 class SolveService:
@@ -132,9 +188,24 @@ class SolveService:
         self.metrics.register_obs("serve")
         # `is not None`, not truthiness: an EMPTY FactorCache has
         # len()==0 and would be silently replaced
-        self.cache = cache if cache is not None else FactorCache(
-            capacity_bytes=self.config.capacity_bytes,
-            backend=self.config.backend, metrics=self.metrics)
+        if cache is not None:
+            self.cache = cache
+        else:
+            cfg = self.config
+            self.cache = FactorCache(
+                capacity_bytes=cfg.capacity_bytes,
+                backend=cfg.backend, metrics=self.metrics,
+                store=(FactorStore(cfg.store_dir,
+                                   metrics=self.metrics)
+                       if cfg.store_dir else None),
+                breaker=(CircuitBreaker(
+                    threshold=cfg.breaker_threshold,
+                    cooldown_s=cfg.breaker_cooldown_s,
+                    metrics=self.metrics)
+                    if cfg.breaker_threshold > 0 else None),
+                retry=(RetryPolicy(attempts=1 + cfg.factor_retries,
+                                   base_s=cfg.retry_base_s)
+                       if cfg.factor_retries > 0 else None))
         if self.cache.on_evict is None:
             # an evicted key's batchers must die with it, or their
             # flusher threads pin the factors the byte bound claims to
@@ -157,6 +228,11 @@ class SolveService:
         # accuracy class: never tier-serve them again (the "re-key" —
         # their next request factors at the requested precision)
         self._tier_blocked: set[CacheKey] = set()
+        # requested keys whose DEGRADED serving missed the accuracy
+        # class: stale factors are a useless preconditioner for these
+        # values — subsequent failures surface as errors, not as
+        # berr-failing degraded answers
+        self._degraded_blocked: set[CacheKey] = set()
         self._inflight = 0
         self._closed = False
 
@@ -285,7 +361,8 @@ class SolveService:
                     mb = self._batcher_for(
                         t_key, t_lu, t_opts,
                         on_berr=self._tier_guard(
-                            key, t_key, t_opts))
+                            key, t_key, t_opts),
+                        variant=("tier",))
                     try:
                         return mb.submit(b, deadline=deadline)
                     except ServeError:
@@ -296,16 +373,34 @@ class SolveService:
                 self.metrics.inc("serve.miss_failfast")
                 raise FactorMissError(
                     f"cold key under failfast policy (pattern "
-                    f"{key.pattern[:12]})")
+                    f"{key.pattern[:12]}; inline factorization costs "
+                    f"{factor_cost_hint()})")
             # "factor" policy: pay it here, once — concurrent misses
             # on this key coalesce into the leader's factorization.
             # Followers respect the request deadline while waiting;
             # the leader runs to completion (see get_or_factorize)
-            lu = self.cache.get_or_factorize(a, options, key=key,
-                                             deadline=deadline)
-        mb = self._batcher_for(key, lu, options or Options())
+            try:
+                lu = self.cache.get_or_factorize(a, options, key=key,
+                                                 deadline=deadline)
+            except (DeadlineExceeded, ServeRejected):
+                raise           # economics, not faults — never degrade
+            except Exception as factor_err:
+                # DEGRADED MODE: the factorization failed (raised, NaN
+                # factors, circuit-broken).  If a stale same-pattern
+                # factorization is resident, serve through it with
+                # refinement against the FRESH matrix — an answer
+                # stamped DegradedResult beats an outage; the berr
+                # guard keeps it honest
+                fut = self._try_degraded(a, key, options or Options(),
+                                         b, deadline, factor_err)
+                if fut is not None:
+                    return fut
+                raise
         try:
-            return mb.submit(b, deadline=deadline)
+            return self._submit_resilient(key, lu, options or Options(),
+                                          b, deadline)
+        except FlusherDead:
+            raise       # lightning struck twice: explicit, not a miss
         except ServeError:
             # the batcher was retired by a concurrent eviction between
             # lookup and submit; the factors are gone — same contract
@@ -313,6 +408,61 @@ class SolveService:
             raise FactorMissError(
                 "factors evicted concurrently; resubmit (or "
                 "prefactor) to re-factor") from None
+
+    def _submit_resilient(self, key: CacheKey, lu: LUFactorization,
+                          options: Options, b, deadline) -> Future:
+        """Submit into the key's batcher with ONE transparent resubmit
+        if the flusher dies under the request: the factors are still
+        resident (a flusher death is a thread fault, not an eviction),
+        _batcher_for replaces the dead batcher, and the caller sees
+        FlusherDead only when the replacement dies too.  Covers both
+        the synchronous raise (submit into a just-died batcher) and
+        the asynchronous one (the request was claimed by the batch the
+        flusher died holding)."""
+        def submit_once() -> Future:
+            return self._batcher_for(key, lu, options).submit(
+                b, deadline=deadline)
+
+        # ONE retry total, shared between the synchronous raise and
+        # the async relay — a request never runs more than twice
+        retry_left = 1
+        try:
+            fut = submit_once()
+        except FlusherDead:
+            retry_left = 0
+            fut = submit_once()
+        out: Future = Future()
+
+        def relay(f: Future, retry_left: int) -> None:
+            # runs on the resolving thread (normally the flusher; on
+            # death, the dying flusher's containment handler — which
+            # holds no locks by then, so re-entering _batcher_for to
+            # build the replacement is safe)
+            if f.cancelled():
+                out.cancel()
+                return
+            e = f.exception()
+            if e is None:
+                out.set_result(f.result())
+            elif isinstance(e, FlusherDead) and retry_left:
+                if deadline is not None \
+                        and time.monotonic() > deadline:
+                    # the resubmit would land late by construction
+                    out.set_exception(DeadlineExceeded(
+                        "deadline passed during flusher recovery"))
+                    return
+                self.metrics.inc("serve.flusher_resubmits")
+                try:
+                    f2 = submit_once()
+                except BaseException as e2:
+                    out.set_exception(e2)
+                    return
+                f2.add_done_callback(lambda g: relay(g, 0))
+            else:
+                out.set_exception(e)
+
+        fut.add_done_callback(lambda f: relay(f, retry_left))
+        return out
 
     def _tier_lookup(self, a: CSRMatrix, options: Options,
                      key: CacheKey):
@@ -374,22 +524,120 @@ class SolveService:
 
         return on_berr
 
+    # -- degraded mode (resilience pillar 4) ---------------------------
+
+    def _try_degraded(self, a: CSRMatrix, key: CacheKey,
+                      options: Options, b, deadline,
+                      cause: BaseException):
+        """A future serving `b` off resident stale same-pattern
+        factors, or None when degraded mode cannot apply (disabled,
+        berr-blocked key, nothing resident).  The handle is a replace
+        copy carrying the FRESH matrix, so iterative refinement
+        computes residuals against the values actually being solved —
+        stale factors act as the preconditioner (ROADMAP item 4b's
+        staleness-tolerant mode, applied as a failure fallback)."""
+        if not self.config.degraded or not isinstance(a, CSRMatrix):
+            return None
+        with self._lock:
+            if key in self._degraded_blocked:
+                return None
+        stale = self.cache.resident_stale(key)
+        if stale is None:
+            return None
+        s_key, s_lu = stale
+        d_opts = self._degraded_options(a, s_lu, options)
+        handle = dataclasses.replace(
+            s_lu, a=a, refine_cache={},
+            cache_lock=threading.Lock())
+        try:
+            mb = self._batcher_for(
+                s_key, handle, d_opts,
+                on_berr=self._degraded_guard(key, d_opts),
+                # per-(requested values) variant: each drifted value
+                # set refines against ITS matrix and must not share a
+                # batch (or a handle) with another's
+                variant=("degraded", key.values))
+            fut = mb.submit(b, deadline=deadline)
+        except ServeError:
+            return None     # stale factors evicted under us: no cover
+        self.metrics.inc("serve.degraded_served")
+        from .. import obs
+        obs.instant("serve.degraded", cat="serve",
+                    args={"pattern": key.pattern[:12],
+                          "cause": type(cause).__name__})
+        return _mark_degraded(fut)
+
+    @staticmethod
+    def _degraded_options(a: CSRMatrix, s_lu: LUFactorization,
+                          options: Options) -> Options:
+        """Degraded solve semantics: refinement is MANDATORY (it is
+        what closes the stale-factor gap), and sub-f64 real factors
+        ride the doubleword residual so the recovered precision
+        matches the f64 class the berr guard checks.  f64-class or
+        complex factors keep their native residual (doubleword is
+        real-only machinery, and over f64 factors it is rejected by
+        the precision policy)."""
+        from ..options import IterRefine
+        d = options
+        if d.iter_refine == IterRefine.NOREFINE:
+            d = d.replace(iter_refine=IterRefine.SLU_DOUBLE)
+        f_dt = np.dtype(s_lu.effective_options.factor_dtype)
+        if (f_dt.kind != "c"
+                and not np.issubdtype(np.dtype(a.dtype),
+                                      np.complexfloating)
+                and np.finfo(f_dt).eps > np.finfo(np.float64).eps):
+            d = d.replace(residual_mode="doubleword",
+                          iter_refine=IterRefine.SLU_DOUBLE)
+        return d
+
+    def _degraded_guard(self, requested_key: CacheKey,
+                        d_opts: Options):
+        """berr watchdog for degraded dispatches — the same accuracy
+        class the tier guard enforces (64·eps(refine_dtype)): a
+        degraded answer whose refinement could not close the
+        stale-factor gap blocks the key from further degraded serving
+        (subsequent failures surface as errors) and fires a
+        `degraded_berr` health escalation."""
+        from .. import obs
+        from ..models.gssvx import _ESC_BERR_SLACK
+        limit = _ESC_BERR_SLACK * float(
+            np.finfo(np.dtype(d_opts.refine_dtype)).eps)
+
+        def on_berr(berr: float) -> None:
+            if berr <= limit and np.isfinite(berr):
+                return
+            with self._lock:
+                already = requested_key in self._degraded_blocked
+                self._degraded_blocked.add(requested_key)
+            if already:
+                return
+            self.metrics.inc("serve.degraded_escalations")
+            obs.HEALTH.record_escalation(
+                berr=berr, factor_dtype=d_opts.factor_dtype,
+                refine_dtype=d_opts.refine_dtype,
+                to_dtype=d_opts.refine_dtype,
+                trigger="degraded_berr")
+
+        return on_berr
+
     def _batcher_for(self, key: CacheKey, lu: LUFactorization,
                      options: Options,
-                     on_berr=None) -> MicroBatcher:
+                     on_berr=None, variant: tuple = ()
+                     ) -> MicroBatcher:
         """One MicroBatcher per (cache key, solve-time options).  Its
         solve_fn merges the request's solve knobs onto the shared
         handle (the gssvx FACTORED rung's merge) so the leader's
         factorization-time knobs never leak into other callers'
         solves — and requests with different trans/refinement never
         land in the same batch."""
-        # tier-served traffic gets its OWN variant (the "tier" leg):
-        # its solve_fn carries the berr guard, and sharing a batcher
-        # created unguarded by direct traffic with the same solve
-        # options would silently drop the guard (and the re-key
-        # contract with it)
-        bkey = (key,) + solve_options_key(options) \
-            + (("tier",) if on_berr is not None else ())
+        # guarded traffic (tier / degraded) gets its OWN variant leg:
+        # its solve_fn carries a berr guard (and, degraded, its own
+        # handle), and sharing a batcher created unguarded by direct
+        # traffic with the same solve options would silently drop the
+        # guard (and the re-key / block contract with it)
+        if on_berr is not None and not variant:
+            variant = ("guarded",)
+        bkey = (key,) + solve_options_key(options) + tuple(variant)
         retired = []
         with self._lock:
             if self._closed:
@@ -398,6 +646,13 @@ class SolveService:
                 # service
                 raise ServeError("service is closed")
             mb = self._batchers.get(bkey)
+            if mb is not None and mb.dead is not None:
+                # a dead flusher already failed its futures
+                # (FlusherDead); replace the batcher so the key
+                # recovers instead of erroring forever
+                self.metrics.inc("serve.batcher_replaced")
+                retired.append(self._batchers.pop(bkey))
+                mb = None
             if mb is not None:
                 self._batchers.move_to_end(bkey)
             else:

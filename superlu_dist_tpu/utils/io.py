@@ -309,6 +309,34 @@ def write_binary(path: str, a: CSRMatrix, index_dtype=np.int32) -> None:
 
 
 # --------------------------------------------------------------------
+# Crash-safe writes (resilience/store.py's durability primitive)
+# --------------------------------------------------------------------
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write `data` to `path` atomically: a reader (or a post-crash
+    restart) sees either the old content or the complete new content,
+    never a torn write.  Standard tmp-file + fsync + rename in the
+    destination directory (os.replace is atomic within a filesystem)."""
+    import os as _os
+    import tempfile as _tempfile
+    d = _os.path.dirname(_os.path.abspath(path)) or "."
+    fd, tmp = _tempfile.mkstemp(prefix=".tmp-",
+                                suffix=_os.path.basename(path), dir=d)
+    try:
+        with _os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            _os.fsync(f.fileno())
+        _os.replace(tmp, path)
+    except BaseException:
+        try:
+            _os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# --------------------------------------------------------------------
 # Postfix dispatch (dcreate_matrix_postfix analog)
 # --------------------------------------------------------------------
 

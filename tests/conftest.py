@@ -41,6 +41,61 @@ jax.config.update("jax_compilation_cache_dir", host_cache_dir(
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 
+# --- hang containment -----------------------------------------------
+# The resilience work (tests/test_resilience.py, serve chaos paths)
+# exists precisely because a future that never resolves would
+# otherwise HANG a test, eat the tier-1 870 s budget and fail the
+# whole suite with no traceback.  Two layers make a hang loud instead:
+# faulthandler (SIGSEGV/deadlock tracebacks always on) and a per-test
+# SIGALRM guard that raises TimeoutError in the test after
+# SLU_TEST_TIMEOUT seconds (default 300), with a faulthandler
+# hard-exit backstop 60 s later for hangs the signal cannot interrupt.
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+faulthandler.enable()
+
+import pytest  # noqa: E402
+
+_TEST_TIMEOUT_S = float(os.environ.get("SLU_TEST_TIMEOUT", "300") or 0)
+
+
+@pytest.fixture(autouse=True)
+def _per_test_hang_guard(request):
+    # deliberately-long opt-in suites (the ~30-min scale
+    # certification, sweep subprocess runs, slow serve loads) are
+    # exempt: their length is the point, not a hang
+    if any(request.node.get_closest_marker(m)
+           for m in ("scale", "sweep", "slow")):
+        yield
+        return
+    if (_TEST_TIMEOUT_S <= 0 or os.name != "posix"
+            or threading.current_thread()
+            is not threading.main_thread()):
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded SLU_TEST_TIMEOUT={_TEST_TIMEOUT_S:.0f}s "
+            "(likely a hung future/lock — see the resilience "
+            "containment contracts)")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    # backstop: a hang inside C code never delivers the Python-level
+    # signal handler; dump all stacks and kill the process instead of
+    # silently eating the suite budget
+    faulthandler.dump_traceback_later(_TEST_TIMEOUT_S + 60, exit=True)
+    signal.setitimer(signal.ITIMER_REAL, _TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        faulthandler.cancel_dump_traceback_later()
+        signal.signal(signal.SIGALRM, old)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -1,4 +1,8 @@
-"""Serve-mode load benchmark: micro-batched vs sequential solves.
+"""Serve-mode load benchmark: micro-batched vs sequential solves —
+plus the chaos gate (`--chaos [SPEC]`), which runs the standard load
+under fault injection (resilience/chaos.py) and gates on zero hangs
+and zero silent wrong answers, appending a record to CHAOS.jsonl
+(SLU_CHAOS_OUT).
 
 Factors one hot matrix (3D Laplacian, k=SLU_SERVE_K), then measures:
 
@@ -25,7 +29,8 @@ import time
 import numpy as np
 
 
-def run(argv=()):
+def _jax_env():
+    """Shared platform/cache setup; returns (repo_root, jax device)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo)
     from superlu_dist_tpu.utils.cache import (cache_dir_for,
@@ -48,6 +53,11 @@ def run(argv=()):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     except Exception:
         pass
+    return repo, dev
+
+
+def run(argv=()):
+    repo, dev = _jax_env()
 
     from superlu_dist_tpu import Options, obs, solve
     from superlu_dist_tpu.serve import (ServeConfig, SolveService,
@@ -173,8 +183,178 @@ def run(argv=()):
     return rec
 
 
+# default chaos spec: every failure class the resilience layer claims
+# to contain, all at once — lead-factorization raises, NaN factors,
+# persisted-entry bit flips, flusher death, dispatch latency
+DEFAULT_CHAOS_SPEC = ("factor_raise=0.3,factor_nan=0.3,store_flip=1,"
+                      "flusher_raise=0.08,latency=0.2:0.003")
+
+
+def run_chaos(spec=None, argv=()):
+    """The chaos gate: restart drill + standard load under fault
+    injection.  Passes iff (a) the restart drill serves the key warm
+    off the store with ZERO new factorizations, (b) every request
+    under chaos resolves (no hangs), and (c) no caller ever receives
+    a non-finite result.  Appends one JSON line to SLU_CHAOS_OUT
+    (default CHAOS.jsonl)."""
+    repo, dev = _jax_env()
+    import shutil
+    import tempfile
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.resilience import chaos
+    from superlu_dist_tpu.resilience.store import FactorStore
+    from superlu_dist_tpu.serve import (FactorCache, ServeConfig,
+                                        SolveService, run_load)
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    spec = (spec or os.environ.get("SLU_CHAOS", "").strip()
+            or DEFAULT_CHAOS_SPEC)
+    seed = int(os.environ.get("SLU_CHAOS_SEED", "0") or "0")
+    k = int(os.environ.get("SLU_SERVE_K", "6"))
+    concurrency = int(os.environ.get("SLU_SERVE_CONCURRENCY", "8"))
+    requests = int(os.environ.get("SLU_SERVE_REQUESTS", "96"))
+    out_path = os.environ.get(
+        "SLU_CHAOS_OUT", os.path.join(repo, "CHAOS.jsonl"))
+    store_dir = tempfile.mkdtemp(prefix="slu_chaos_store_")
+    try:
+        a = laplacian_3d(k)
+        opts = Options(factor_dtype="float64")
+        # same pattern, drifted values (a transient-sim step family):
+        # every variant is a cold full key whose factorization chaos
+        # can kill — and the degraded-mode cover target for the
+        # prefactored baseline's factors
+        import dataclasses as _dc
+        variants = [_dc.replace(a, data=a.data * (1.0 + i * 1e-8))
+                    for i in range(1, 5)]
+
+        svc = SolveService(ServeConfig(
+            max_queue_depth=max(64, 4 * requests),
+            store_dir=store_dir, factor_retries=2,
+            retry_base_s=0.01, breaker_threshold=3,
+            breaker_cooldown_s=0.5, degraded=True))
+        print(f"# chaos: factoring n={a.n} (k={k}) ...",
+              file=sys.stderr)
+        key = svc.prefactor(a, opts)
+
+        # --- restart gate: kill the replica (drop the cache), keep
+        # the store dir; a fresh cache must serve the key warm with
+        # zero new factorizations and a checksum-verified load
+        cache2 = FactorCache(backend=svc.config.backend,
+                             store=FactorStore(store_dir))
+        lu2 = cache2.get_or_factorize(a, opts, key=key)
+        st2 = cache2.stats()
+        restart = {
+            "factorizations": st2["factorizations"],
+            "store_hits": st2["store_hits"],
+            "warm": (st2["factorizations"] == 0
+                     and st2["store_hits"] == 1
+                     and lu2 is not None),
+        }
+        del cache2, lu2
+
+        # --- chaos load: fresh values under injected failures
+        print(f"# chaos: load under spec {spec!r} seed={seed}",
+              file=sys.stderr)
+        policy = chaos.install(spec, seed=seed)
+        try:
+            report = run_load(svc, [a] + variants, requests=requests,
+                              concurrency=concurrency,
+                              hot_fraction=0.4, seed=seed,
+                              join_timeout_s=300.0)
+        finally:
+            chaos.uninstall()
+        # --- corrupt-restart drill: a fresh replica boots against a
+        # store whose every read is bit-flipped (chaos store_flip) —
+        # every entry must QUARANTINE (never serve corrupt factors)
+        # and the request must still succeed via a fresh
+        # factorization
+        chaos.install("store_flip=1", seed=seed)
+        try:
+            cache3 = FactorCache(backend=svc.config.backend,
+                                 store=FactorStore(store_dir))
+            lu3 = cache3.get_or_factorize(a, opts, key=key)
+            st3 = cache3.stats()
+            corrupt_restart = {
+                "quarantined": st3["store_quarantined"],
+                "refactored": st3["factorizations"],
+                "served": lu3 is not None,
+                "contained": (st3["store_quarantined"] >= 1
+                              and st3["store_hits"] == 0
+                              and lu3 is not None),
+            }
+            del cache3, lu3
+        finally:
+            chaos.uninstall()
+
+        m = svc.metrics
+        rec = {
+            "mode": "chaos",
+            "spec": spec,
+            "seed": seed,
+            "n": a.n,
+            "k": k,
+            "requests": requests,
+            "concurrency": concurrency,
+            "by_status": report["by_status"],
+            "unresolved": report["unresolved"],
+            "chaos_fired": policy.fired(),
+            "restart": restart,
+            "corrupt_restart": corrupt_restart,
+            "cache": svc.cache.stats(),
+            "store": svc.cache.store.stats(),
+            "degraded_served": m.counter("serve.degraded_served"),
+            "degraded_escalations":
+                m.counter("serve.degraded_escalations"),
+            "flusher_deaths": m.counter("batcher.flusher_died"),
+            "batchers_replaced": m.counter("serve.batcher_replaced"),
+            "breaker": (svc.cache.breaker.snapshot()
+                        if svc.cache.breaker else None),
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        svc.close()
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    nonfinite = rec["by_status"].get("nonfinite", 0)
+    resolved_ok = rec["unresolved"] == 0
+    # the documented contract is success / TYPED ServeError /
+    # stamped-degraded: an untyped "error" outcome (a genuine bug
+    # caught by the loadgen's last-resort handler) fails the gate too
+    untyped = rec["by_status"].get("error", 0)
+    rec["gate"] = {
+        "zero_hangs": resolved_ok,
+        "zero_nonfinite": nonfinite == 0,
+        "all_typed": untyped == 0,
+        "restart_warm": rec["restart"]["warm"],
+        "corruption_contained": rec["corrupt_restart"]["contained"],
+        "passed": (resolved_ok and nonfinite == 0 and untyped == 0
+                   and rec["restart"]["warm"]
+                   and rec["corrupt_restart"]["contained"]),
+    }
+    line = json.dumps(rec)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    if not rec["gate"]["passed"]:
+        print(f"# CHAOS GATE FAILED: unresolved={rec['unresolved']} "
+              f"nonfinite={nonfinite} restart={rec['restart']}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return rec
+
+
 def main():
-    rec = run(sys.argv[1:])
+    argv = sys.argv[1:]
+    if "--chaos" in argv:
+        i = argv.index("--chaos")
+        spec = (argv[i + 1] if i + 1 < len(argv)
+                and not argv[i + 1].startswith("--") else None)
+        run_chaos(spec, argv)
+        return
+    rec = run(argv)
     # regression gate: batching must never LOSE to sequential and
     # never recompile under load — fail the process so exit-code gates
     # (and bench.py --serve) see it.  The floor defaults to 1.0
