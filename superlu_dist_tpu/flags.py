@@ -49,6 +49,15 @@ FLAGS: dict[str, str] = {
     "SLU_TRACE": "Chrome trace-event JSON export path, written at process exit (1 = ./last.trace.json; implies SLU_OBS; ~1 µs + one dict per span while on)",
     "SLU_TRACE_JSONL": "JSONL event-log path, appended through as spans close (implies SLU_OBS; adds one file write per span)",
     "SLU_OBS_COST": "1 = XLA cost-analysis FLOP/byte accounting on each jit cache miss -> Stats.ops_measured (re-pays one AOT lower+compile per NEW signature; zero cost on the recompile-free hot path)",
+    # --- request-scoped flight recorder + SLO engine (obs/flight.py, obs/slo.py) ---
+    "SLU_FLIGHT": "1/0 per-request flight recorder: every SolveService request gets a monotonic rid and a stage-event record (admit/cache/queue/solve/refine + resilience events) in a bounded ring; off = ONE module-global pointer check on the request path (zero growth, pinned by the serve_bench --flight-ab record); on costs a few dict/list appends per request (<5% at the k=8 CPU load)",
+    "SLU_FLIGHT_JSONL": "flight-record JSONL sink path, one line per RETAINED record as it finishes (implies SLU_FLIGHT; adds one file write per retained request; self-disables on I/O error; tools/trace_export.py renders it as per-request Perfetto tracks)",
+    "SLU_FLIGHT_RING": "flight-record ring capacity (default 256): completed records kept for obs.snapshot()/lookup; non-ok outcomes are always retained until displaced by newer records",
+    "SLU_FLIGHT_SAMPLE": "keep 1-in-N of `ok` flight records (default 1 = all); failures are ALWAYS retained regardless — sampling bounds sink volume under sustained healthy traffic, never traceability",
+    "SLU_SLO": "SLO declaration: '1' = defaults (p99_ms=100, avail=0.99, window_s=60); 'p99_ms=50,avail=0.999,window_s=60[;scope:field=v]' with n-bucket/dtype-tier scoped overrides; sliding-window burn-rate accounting per (n-bucket, dtype tier) with exemplar rids on violated windows; off = one pointer check per request completion",
+    "SLU_FLIGHT_AB_TRIALS": "serve_bench --flight-ab interleaved trial-pair count (default 5; median per arm is the measurement)",
+    "SLU_FLIGHT_MAX_OVERHEAD": "serve_bench --flight-ab failure threshold on flight-on vs flight-off throughput loss (default 0.05 — the ISSUE-8 overhead acceptance)",
+    "SLU_REGRESS": "0 = skip the perf-regression sentinel gate serve_bench runs after appending its record (tools/regress.py vs BASELINES.json; default on)",
     # --- mixed precision (precision/, options.py, serve/service.py) ---
     "SLU_PREC_RESIDUAL": "auto|plain|doubleword|fp64 default Options.residual_mode: how the IR residual accumulates (doubleword = two-float fp32 df64, ~25 f32 flops/term vs 2 — noise next to fp64 EMULATION on TPU, and zero f64 ops in the jitted path; host loop uses native f64 either way)",
     "SLU_PREC_LADDER": "comma dtype list overriding the escalation ladder (default bfloat16,float32,float64; sorted by eps, climbed one rung per failed refinement contract — each rung re-pays one factorization)",

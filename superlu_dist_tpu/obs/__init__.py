@@ -18,6 +18,16 @@ answer live:
   * are the numerics drifting? — `health`: tiny-pivot replacement
     counts, pivot-growth estimates, berr/ferr trajectories and
     escalation events — the GESP runtime-watch obligation.
+  * what happened to THIS request? — `flight`: per-request flight
+    records (monotonic rid, stage events through admission → cache →
+    batcher → solve → refine → resilience, bounded ring +
+    SLU_FLIGHT_JSONL sink, per-request Perfetto tracks via
+    tools/trace_export.py).  Gated by SLU_FLIGHT; one pointer check
+    when off.
+  * are we meeting what we sold? — `slo`: declared
+    latency/availability objectives per (n-bucket, dtype tier) with
+    sliding-window burn rates and exemplar rids on violated windows
+    (SLU_SLO).
 
 Everything registers into ONE `Registry` (`REGISTRY`): per-run phase
 stats (utils/stats.py), serve metrics (serve/metrics.py), the compile
@@ -27,20 +37,24 @@ Prometheus-style text dump (wired into `SolveService` and
 `bench.py --serve`).
 """
 
+from . import flight, slo
 from .compile_watch import (COMPILE_WATCH, CompileWatch, stamp_cost,
                             take_cost, watch_jit)
+from .flight import FlightRecord, FlightRecorder
 from .health import HEALTH, HealthMonitor, pivot_growth
 from .registry import REGISTRY, Registry
+from .slo import Objective, SloEngine
 from .tracer import (NULL_SPAN, Tracer, complete, configure, enabled,
                      export_trace, get_tracer, instant,
                      resolve_trace_path, span)
 
 __all__ = [
-    "COMPILE_WATCH", "CompileWatch", "HEALTH", "HealthMonitor",
-    "NULL_SPAN", "REGISTRY", "Registry", "Tracer", "complete",
-    "configure", "dump_text", "enabled", "export_trace", "get_tracer",
-    "instant", "pivot_growth", "resolve_trace_path", "snapshot",
-    "span", "stamp_cost", "take_cost", "watch_jit",
+    "COMPILE_WATCH", "CompileWatch", "FlightRecord", "FlightRecorder",
+    "HEALTH", "HealthMonitor", "NULL_SPAN", "Objective", "REGISTRY",
+    "Registry", "SloEngine", "Tracer", "complete", "configure",
+    "dump_text", "enabled", "export_trace", "flight", "get_tracer",
+    "instant", "pivot_growth", "resolve_trace_path", "slo",
+    "snapshot", "span", "stamp_cost", "take_cost", "watch_jit",
 ]
 
 

@@ -50,6 +50,12 @@ class CircuitBreaker:
         st.state = new
         if self._metrics is not None:
             self._metrics.inc(f"breaker.to_{new}")
+        # request-scoped linkage: a state flip lands on the flight
+        # record of the request that caused it (obs/flight.py; no-op
+        # off or when no record is bound to this thread)
+        from ..obs import flight
+        flight.event("breaker.transition", to=new,
+                     failures=st.failures)
 
     def allow(self, key) -> bool:
         """May a factorization attempt for `key` proceed?  Closed:

@@ -32,6 +32,7 @@ import numpy as np
 
 from .. import obs
 from ..models.gssvx import LUFactorization, solve, solve_rhs_dtype
+from ..obs import flight
 from ..resilience import chaos
 from .errors import DeadlineExceeded, FlusherDead, ServeError
 from .metrics import Metrics
@@ -55,13 +56,17 @@ def bucket_for(nrhs: int, ladder=BUCKET_LADDER) -> int:
 
 
 class _Request:
-    __slots__ = ("b", "deadline", "future", "t_submit")
+    __slots__ = ("b", "deadline", "future", "t_submit", "flight")
 
     def __init__(self, b, deadline):
         self.b = b
         self.deadline = deadline          # absolute monotonic time or None
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        # the submitting thread's flight record (None when the
+        # recorder is off — one pointer check): the flusher thread
+        # appends this request's queue/solve/refine events through it
+        self.flight = flight.current()
 
 
 class MicroBatcher:
@@ -214,6 +219,8 @@ class MicroBatcher:
         err = FlusherDead(f"flusher thread died: {e!r}")
         err.__cause__ = e
         for r in victims:
+            if r.flight is not None:
+                r.flight.event("flusher_died", error=repr(e))
             # a claimed request is already running (the handshake
             # below then raises and is swallowed); a queued one needs
             # it first.  Either way the future must RESOLVE.
@@ -277,6 +284,10 @@ class MicroBatcher:
                 continue                      # caller cancelled in queue
             if r.deadline is not None and now > r.deadline:
                 self.metrics.inc("batcher.deadline_dropped")
+                if r.flight is not None:
+                    r.flight.event(
+                        "queue.deadline_dropped",
+                        wait_us=int((now - r.t_submit) * 1e6))
                 r.future.set_exception(DeadlineExceeded(
                     "deadline passed while queued"))
                 continue
@@ -290,6 +301,12 @@ class MicroBatcher:
             return
         t0 = time.monotonic()
         k = bucket_for(len(live), self.ladder)
+        # per-request flight linkage: one recorder-global batch id
+        # ties the records dispatched together (None when off).  The
+        # queue/solve observations are folded into ONE event per
+        # request, appended after the solve — this loop runs on the
+        # flusher thread, the serve throughput bottleneck.
+        bid = flight.next_batch_id()
         with obs.span("serve.assemble", cat="serve",
                       args={"batch": len(live), "nrhs": k}):
             B = np.zeros((self.lu.n, k), dtype=self.dtype)
@@ -302,20 +319,34 @@ class MicroBatcher:
         t1 = time.monotonic()
         # chaos site: artificial dispatch latency (deadline storms)
         chaos.maybe_sleep("latency")
+        # bind the dispatch's records so per-BATCH observations made
+        # inside solve_fn (refine berr, tier/degraded guard blocks)
+        # fan out to every request served by it
+        flight.batch_begin([r.flight for r in live])
         try:
             with obs.span("serve.batch_solve", cat="serve",
                           args={"nrhs": k,
                                 "occupancy": len(live) / k}):
                 X = self._solve_fn(self.lu, B)
         except BaseException as e:
+            flight.batch_event("solve.error", error=repr(e))
             for r in live:
                 r.future.set_exception(e)
             return
-        self.metrics.observe("serve.device_solve_s",
-                             time.monotonic() - t1)
+        finally:
+            flight.batch_end()
+        solve_s = time.monotonic() - t1
+        self.metrics.observe("serve.device_solve_s", solve_s)
         self.batches_dispatched += 1
         done = time.monotonic()
+        solve_us = int(solve_s * 1e6)
+        occ = round(len(live) / k, 4) if bid is not None else 0.0
         for j, r in enumerate(live):
+            if r.flight is not None:
+                r.flight.event(
+                    "queue", wait_us=int((now - r.t_submit) * 1e6),
+                    batch=bid, bucket=k, occupancy=occ,
+                    solve_us=solve_us)
             if r.deadline is not None and done > r.deadline:
                 # the work is done, but a missed deadline must never
                 # read as success — the caller already moved on

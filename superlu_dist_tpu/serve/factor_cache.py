@@ -65,6 +65,7 @@ import numpy as np
 
 from ..models.gssvx import (LUFactorization, effective_factor_dtype,
                             factorize, factors_finite, query_space)
+from ..obs import flight
 from ..options import Options
 from ..plan.plan import plan_factorization
 from ..resilience import chaos
@@ -270,8 +271,10 @@ class FactorCache:
             if ent is not None:
                 self._entries.move_to_end(key)
                 self.metrics.inc("factor_cache.hits")
+                flight.event("cache.hit")
                 return ent.lu
         self.metrics.inc("factor_cache.misses")
+        flight.event("cache.miss")
         return None
 
     def get_or_factorize(self, a: CSRMatrix,
@@ -296,29 +299,36 @@ class FactorCache:
                 if ent is not None:
                     self._entries.move_to_end(key)
                     self.metrics.inc("factor_cache.hits")
+                    flight.event("cache.hit")
                     return ent.lu
-                flight = self._inflight.get(key)
-                if flight is None:
-                    flight = self._inflight[key] = _Flight()
+                fl = self._inflight.get(key)
+                if fl is None:
+                    fl = self._inflight[key] = _Flight()
                     leader = True
                 else:
                     leader = False
             if not leader:
                 self.metrics.inc("factor_cache.single_flight_waits")
+                flight.event("cache.single_flight_wait")
+                t_wait = time.monotonic()
                 timeout = (None if deadline is None
                            else max(0.0, deadline - time.monotonic()))
-                if not flight.event.wait(timeout):
+                if not fl.event.wait(timeout):
                     raise DeadlineExceeded(
                         "deadline passed waiting on another caller's "
                         "in-flight factorization")
-                if flight.error is not None:
-                    raise flight.error
-                if flight.lu is not None:
-                    return flight.lu
+                flight.event(
+                    "cache.single_flight_done",
+                    waited_us=int((time.monotonic() - t_wait) * 1e6),
+                    ok=fl.error is None)
+                if fl.error is not None:
+                    raise fl.error
+                if fl.lu is not None:
+                    return fl.lu
                 continue  # leader aborted without result; re-elect
-            return self._lead_factorization(a, options, key, flight)
+            return self._lead_factorization(a, options, key, fl)
 
-    def _lead_factorization(self, a, options, key, flight):
+    def _lead_factorization(self, a, options, key, fl):
         # CONTAINMENT CONTRACT (pinned by tests/test_resilience.py):
         # whatever _acquire_factors raises is (a) recorded on the
         # flight so every waiting follower wakes with the SAME
@@ -327,18 +337,19 @@ class FactorCache:
         # retries cleanly instead of hanging on a dead flight or
         # finding a permanently-poisoned key slot.
         self.metrics.inc("factor_cache.misses")
+        flight.event("cache.miss_lead")
         try:
             lu = self._acquire_factors(a, options, key)
             self.put(key, lu)
-            flight.lu = lu
+            fl.lu = lu
             return lu
         except BaseException as e:
-            flight.error = e
+            fl.error = e
             raise
         finally:
             with self._lock:
                 self._inflight.pop(key, None)
-            flight.event.set()
+            fl.event.set()
 
     def _acquire_factors(self, a, options, key) -> LUFactorization:
         """Factors for a confirmed miss: breaker gate → store

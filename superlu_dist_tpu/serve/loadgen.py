@@ -79,10 +79,13 @@ def run_load(service: SolveService, matrices, *,
             else:
                 mi = 1 + int(rng.integers(len(matrices) - 1))
             b = rng.standard_normal(dims[mi])
+            # out-of-band request metadata: the flight-recorder rid
+            # (None with SLU_FLIGHT off) keys the exemplar report
+            info: dict = {}
             t0 = time.monotonic()
             try:
                 x = service.solve(matrices[mi], b, options=options,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, info=info)
                 if not np.all(np.isfinite(x)):
                     # a non-finite "success" is the one outcome the
                     # chaos gate forbids outright — never fold it into
@@ -111,7 +114,8 @@ def run_load(service: SolveService, matrices, *,
                 # truncated report
                 status = "error"
             with res_lock:
-                results.append((time.monotonic() - t0, status))
+                results.append((time.monotonic() - t0, status,
+                                info.get("request_id")))
 
     threads = [threading.Thread(target=worker, args=(i, c), daemon=True)
                for i, c in enumerate(counts)]
@@ -126,12 +130,17 @@ def run_load(service: SolveService, matrices, *,
         for t in threads:
             t.join(max(0.0, join_deadline - time.monotonic()))
     wall_s = time.monotonic() - t_start
+    # flush deferred flight/SLO finalizations before the report reads
+    # exemplar rids (finalization is deferred off the flusher thread)
+    service.drain_observability()
 
     by_status: dict[str, int] = {}
-    for _, s in results:
+    for _, s, _rid in results:
         by_status[s] = by_status.get(s, 0) + 1
     from .metrics import nearest_rank
-    ok_lat = np.array(sorted(lat for lat, s in results if s == "ok"))
+    ok = sorted(((lat, rid) for lat, s, rid in results if s == "ok"),
+                key=lambda t: t[0])
+    ok_lat = np.array([lat for lat, _ in ok])
     report = {
         "requests": requests,
         "concurrency": n_workers,
@@ -144,6 +153,7 @@ def run_load(service: SolveService, matrices, *,
         "unresolved": requests - len(results),
         "solves_per_s": (len(ok_lat) / wall_s) if wall_s > 0 else 0.0,
         "metrics": service.metrics.snapshot(),
+        "exemplars": _exemplars(ok, results),
     }
     if len(ok_lat):
         def pct(p):
@@ -151,3 +161,28 @@ def run_load(service: SolveService, matrices, *,
         report.update(p50_ms=pct(50), p95_ms=pct(95), p99_ms=pct(99),
                       mean_ms=float(ok_lat.mean()) * 1e3)
     return report
+
+
+def _exemplars(ok_sorted, results, cap: int = 8) -> dict:
+    """Request IDs that make a committed record one lookup from its
+    flight records (obs/flight.py): the p99 and worst `ok` requests,
+    and every non-ok status's rids (bounded).  rids are None when the
+    flight recorder is off."""
+    out: dict = {"p99": None, "worst": [], "by_status": {}}
+    if ok_sorted:
+        p99_i = min(len(ok_sorted) - 1,
+                    max(0, int(round(0.99 * (len(ok_sorted) - 1)))))
+        lat, rid = ok_sorted[p99_i]
+        out["p99"] = {"rid": rid, "ms": round(lat * 1e3, 3)}
+        out["worst"] = [{"rid": rid, "ms": round(lat * 1e3, 3)}
+                        for lat, rid in ok_sorted[-cap:][::-1]]
+    # keep the LAST rids per status: the flight ring retains the most
+    # recent records, so early failures may already be displaced —
+    # exemplars must stay resolvable against the ring
+    for lat, s, rid in results:
+        if s == "ok":
+            continue
+        out["by_status"].setdefault(s, []).append(rid)
+    for s, rids in out["by_status"].items():
+        del rids[:-cap * 2]
+    return out

@@ -49,6 +49,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..models.gssvx import LUFactorization, solve
+from ..obs import flight, slo
 from ..options import Options, merge_solve_options, solve_options_key
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import RetryPolicy
@@ -56,8 +57,8 @@ from ..resilience.store import FactorStore
 from ..sparse import CSRMatrix
 from .batcher import BUCKET_LADDER, MicroBatcher
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
-                     FlusherDead, ServeError, ServeRejected,
-                     factor_cost_hint)
+                     FactorPoisoned, FlusherDead, ServeError,
+                     ServeRejected, factor_cost_hint)
 from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
@@ -86,6 +87,11 @@ def _merged_solve_fn(options: Options, metrics: Metrics | None = None,
     def fn(lu: LUFactorization, B):
         x, st, merged = raw(lu, B)
         if merged.iter_refine != IterRefine.NOREFINE:
+            # per-request linkage: the batcher bound this dispatch's
+            # flight records before calling us (batch_begin), so the
+            # batch-level berr fans out to every request it served
+            flight.batch_event("refine", berr=float(st.berr),
+                               steps=int(st.refine_steps or 0))
             if metrics is not None:
                 metrics.observe("serve.berr", float(st.berr))
                 if st.refine_steps:
@@ -235,6 +241,18 @@ class SolveService:
         self._degraded_blocked: set[CacheKey] = set()
         self._inflight = 0
         self._closed = False
+        # request-scoped observability scratch (the SLO key computed
+        # during routing, read back by submit on the same thread)
+        self._tls = threading.local()
+        # deferred flight/SLO finalizations: the done-callback runs on
+        # the batcher's FLUSHER thread — the serve throughput
+        # bottleneck — so it only stamps the latency and enqueues;
+        # submitting threads (and close/obs_snapshot/recorder reads,
+        # via the flight drain hook) drain.  Keeps the flight-on
+        # flusher cost to ~a few dict appends per request (the
+        # --flight-ab <=5% overhead budget).
+        self._pending_fin: collections.deque = collections.deque()
+        flight.register_drain_hook(self._drain_observability)
 
     # -- operator surface ---------------------------------------------
 
@@ -262,53 +280,119 @@ class SolveService:
             self._batchers.clear()
         for b in batchers:
             b.close()
+        self._drain_observability()
         self.metrics.unregister_obs("serve")
+
+    def drain_observability(self) -> None:
+        """Flush deferred flight/SLO finalizations NOW — call before
+        reading the flight ring or SLO windows outside the request
+        flow (run_load does, after its workers join)."""
+        self._drain_observability()
 
     def obs_snapshot(self) -> dict:
         """The unified observability snapshot (obs.Registry): serve
         metrics + phase stats + compile misses + health monitors."""
         from .. import obs
+        self._drain_observability()
         return obs.snapshot()
 
     def dump_metrics_text(self) -> str:
         """Flat Prometheus-style text dump of the same registry."""
         from .. import obs
+        self._drain_observability()
         return obs.dump_text()
 
     # -- request path --------------------------------------------------
 
     def submit(self, a: CSRMatrix | CacheKey, b: np.ndarray,
                options: Options | None = None,
-               deadline_s: float | None = None) -> Future:
+               deadline_s: float | None = None,
+               _t0: float | None = None) -> Future:
         """Admit one solve request; resolves to x.  `a` may be the
         matrix itself or a CacheKey from prefactor() (keyed submits
-        skip fingerprint hashing on the hot path)."""
-        with self._lock:
-            if self._closed:
-                raise ServeError("service is closed")
-            if self._inflight >= self.config.max_queue_depth:
-                self.metrics.inc("serve.rejected")
-                raise ServeRejected(
-                    f"queue depth {self._inflight} at cap "
-                    f"{self.config.max_queue_depth}")
-            self._inflight += 1
+        skip fingerprint hashing on the hot path).  `_t0` is the
+        deadline base (solve() passes its own entry time so the
+        blocking wait and the batcher enforce the SAME absolute
+        deadline — a result landing in the skew window must not read
+        'ok' on a future whose caller already timed out).
+
+        With the flight recorder on (obs/flight.py, SLU_FLIGHT) the
+        request gets a monotonic request ID — exposed as
+        `future.request_id`, attached to synchronously-raised serve
+        errors as `e.request_id` — and a FlightRecord tracing it
+        through cache, batcher, solve and every resilience event.
+        Off, this path pays one module-global pointer check."""
+        rec = flight.start()       # None when the recorder is off
+        t0 = _t0 if _t0 is not None else time.monotonic()
+        observed = rec is not None or slo.enabled()
+        if observed:
+            self._tls.slo_key = None
+            if self._pending_fin:
+                self._drain_observability()
         try:
-            future = self._route(a, b, options, deadline_s)
-        except BaseException:
+            with self._lock:
+                if self._closed:
+                    raise ServeError("service is closed")
+                if self._inflight >= self.config.max_queue_depth:
+                    self.metrics.inc("serve.rejected")
+                    raise ServeRejected(
+                        f"queue depth {self._inflight} at cap "
+                        f"{self.config.max_queue_depth}")
+                self._inflight += 1
+        except BaseException as e:
+            self._abort_request(rec, t0, e)
+            raise
+        if rec is not None:
+            rec.event("admit", inflight=self._inflight,
+                      deadline_s=deadline_s)
+        flight.set_current(rec)
+        try:
+            future = self._route(a, b, options, deadline_s, t0=t0)
+        except BaseException as e:
             with self._lock:
                 self._inflight -= 1
+            self._abort_request(rec, t0, e)
             raise
-        future.add_done_callback(self._release)
+        finally:
+            if rec is not None:
+                flight.set_current(None)
+        if observed:
+            skey = getattr(self._tls, "slo_key", None)
+            if rec is not None:
+                future.request_id = rec.rid
+            # ONE combined callback, and it does almost nothing: it
+            # runs on the flusher thread (the serve throughput
+            # bottleneck), so it stamps the e2e latency and defers
+            # the flight/SLO finalization to a submitting thread
+            future.add_done_callback(
+                lambda f: (self._release(f),
+                           self._pending_fin.append(
+                               (f, rec, time.monotonic() - t0,
+                                skey))))
+        else:
+            future.add_done_callback(self._release)
         return future
 
     def solve(self, a: CSRMatrix | CacheKey, b: np.ndarray,
               options: Options | None = None,
-              deadline_s: float | None = None) -> np.ndarray:
-        """Blocking submit; respects the deadline while waiting."""
+              deadline_s: float | None = None,
+              info: dict | None = None) -> np.ndarray:
+        """Blocking submit; respects the deadline while waiting.
+        Pass `info={}` to receive out-of-band request metadata —
+        currently `info['request_id']`, the flight-recorder rid (None
+        when the recorder is off) — without changing the return
+        type."""
         deadline_s = (deadline_s if deadline_s is not None
                       else self.config.default_deadline_s)
         t0 = time.monotonic()
-        future = self.submit(a, b, options, deadline_s)
+        try:
+            future = self.submit(a, b, options, deadline_s, _t0=t0)
+        except BaseException as e:
+            if info is not None:
+                info["request_id"] = getattr(e, "request_id", None)
+            raise
+        if info is not None:
+            info["request_id"] = getattr(future, "request_id", None)
         timeout = None
         if deadline_s is not None:
             timeout = max(0.0, t0 + deadline_s - time.monotonic())
@@ -328,11 +412,104 @@ class SolveService:
         with self._lock:
             self._inflight -= 1
 
-    def _route(self, a, b, options, deadline_s) -> Future:
+    # -- request-scoped observability (obs/flight.py, obs/slo.py) ------
+
+    @staticmethod
+    def _outcome_of(e: BaseException | None) -> str:
+        """Exception -> the loadgen/flight outcome taxonomy (order
+        matters: every serve error derives from ServeError)."""
+        if e is None:
+            return "ok"
+        for cls, name in ((ServeRejected, "rejected"),
+                          (DeadlineExceeded, "deadline"),
+                          (FactorPoisoned, "poisoned"),
+                          (FlusherDead, "flusher_dead"),
+                          (FactorMissError, "miss_failfast"),
+                          (ServeError, "serve_error")):
+            if isinstance(e, cls):
+                return name
+        return "error"
+
+    def _note_route(self, rec, lu: LUFactorization,
+                    served: str = "direct") -> None:
+        """Stamp routing facts known only once factors are resolved:
+        the SLO accounting key (n-bucket, dtype tier) and the flight
+        meta.  No-op unless the request is observed.  The (key, tier)
+        pair is cached on the handle — np.dtype+format per request is
+        measurable at micro-batch QPS."""
+        if rec is None and not slo.enabled():
+            return
+        cached = getattr(lu, "_slo_leg", None)
+        if cached is None:
+            tier = np.dtype(lu.effective_options.factor_dtype).name
+            cached = (slo.slo_key(lu.n, tier), tier)
+            try:
+                object.__setattr__(lu, "_slo_leg", cached)
+            except Exception:
+                pass               # frozen/slotted handle: recompute
+        self._tls.slo_key = cached[0]
+        if rec is not None:
+            rec.annotate(n=lu.n, tier=cached[1], served=served)
+
+    def _abort_request(self, rec, t0: float,
+                       e: BaseException) -> None:
+        """Synchronous-raise bookkeeping: finish the flight record,
+        feed the SLO engine, and attach the rid to the exception so
+        blocking callers can still correlate."""
+        outcome = self._outcome_of(e)
+        rid = None
+        if rec is not None:
+            rec.finish(outcome, error=e)
+            rid = rec.rid
+            try:
+                e.request_id = rid
+            except Exception:
+                pass
+        slo.observe(getattr(self._tls, "slo_key", None) or "unrouted",
+                    time.monotonic() - t0, ok=False, rid=rid)
+
+    def _drain_observability(self) -> None:
+        """Finalize deferred flight/SLO completions (thread-safe:
+        deque.popleft is atomic; a record finishes at most once)."""
+        dq = self._pending_fin
+        while dq:
+            try:
+                fut, rec, lat, skey = dq.popleft()
+            except IndexError:
+                break
+            self._finish_request(fut, rec, lat, skey)
+
+    def _finish_request(self, fut: Future, rec, lat: float,
+                        skey: str | None) -> None:
+        """Close the loop on an admitted request; `lat` is the e2e
+        latency stamped by the done-callback."""
+        if fut.cancelled():
+            outcome, e = "cancelled", None
+        else:
+            e = fut.exception()
+            if e is None:
+                outcome = ("degraded"
+                           if isinstance(fut.result(), DegradedResult)
+                           else "ok")
+            else:
+                outcome = self._outcome_of(e)
+        if rec is not None:
+            rec.finish(outcome, error=e, e2e_s=lat)
+        # degraded counts as SERVED for availability: it is a
+        # berr-guarded answer, the honest alternative to an outage
+        slo.observe(skey or "unrouted", lat,
+                    ok=outcome in ("ok", "degraded"),
+                    rid=rec.rid if rec is not None else None)
+
+    def _route(self, a, b, options, deadline_s,
+               t0: float | None = None) -> Future:
         deadline_s = (deadline_s if deadline_s is not None
                       else self.config.default_deadline_s)
-        deadline = (time.monotonic() + deadline_s
-                    if deadline_s is not None else None)
+        # deadline base = the caller's submit entry time, so the
+        # batcher's late-solve check and solve()'s blocking wait agree
+        deadline = ((t0 if t0 is not None else time.monotonic())
+                    + deadline_s if deadline_s is not None else None)
+        rec = flight.current()
         if isinstance(a, CacheKey):
             key = a
             # get(), not peek(): keyed submits ARE the hot path, and
@@ -342,6 +519,7 @@ class SolveService:
                 raise FactorMissError(
                     "keyed submit for a key no longer resident; "
                     "prefactor() it again")
+            self._note_route(rec, lu)
             if options is None:
                 # a keyed submit without options means "as
                 # prefactored" — same solve semantics, same warmed
@@ -358,6 +536,11 @@ class SolveService:
                 if tiered is not None:
                     t_key, t_lu, t_opts = tiered
                     self.metrics.inc("serve.dtype_tier_hits")
+                    self._note_route(rec, t_lu, served="tier")
+                    if rec is not None:
+                        rec.event(
+                            "tier.hit",
+                            rung=np.dtype(t_opts.factor_dtype).name)
                     mb = self._batcher_for(
                         t_key, t_lu, t_opts,
                         on_berr=self._tier_guard(
@@ -396,6 +579,7 @@ class SolveService:
                 if fut is not None:
                     return fut
                 raise
+            self._note_route(rec, lu)
         try:
             return self._submit_resilient(key, lu, options or Options(),
                                           b, deadline)
@@ -419,9 +603,20 @@ class SolveService:
         the synchronous raise (submit into a just-died batcher) and
         the asynchronous one (the request was claimed by the batch the
         flusher died holding)."""
+        # carried explicitly: the async resubmit runs on the dying
+        # flusher's thread, where no thread-local current record is
+        # bound — without this the resubmitted leg would vanish from
+        # the request's flight record
+        f_rec = flight.current()
+
         def submit_once() -> Future:
-            return self._batcher_for(key, lu, options).submit(
-                b, deadline=deadline)
+            flight.set_current(f_rec)
+            try:
+                return self._batcher_for(key, lu, options).submit(
+                    b, deadline=deadline)
+            finally:
+                if f_rec is not None:
+                    flight.set_current(None)
 
         # ONE retry total, shared between the synchronous raise and
         # the async relay — a request never runs more than twice
@@ -452,6 +647,8 @@ class SolveService:
                         "deadline passed during flusher recovery"))
                     return
                 self.metrics.inc("serve.flusher_resubmits")
+                if f_rec is not None:
+                    f_rec.event("resubmit")
                 try:
                     f2 = submit_once()
                 except BaseException as e2:
@@ -511,6 +708,7 @@ class SolveService:
         def on_berr(berr: float) -> None:
             if berr <= limit and np.isfinite(berr):
                 return
+            flight.batch_event("tier.berr_block", berr=float(berr))
             with self._lock:
                 already = requested_key in self._tier_blocked
                 self._tier_blocked.add(requested_key)
@@ -561,6 +759,12 @@ class SolveService:
         except ServeError:
             return None     # stale factors evicted under us: no cover
         self.metrics.inc("serve.degraded_served")
+        rec = flight.current()
+        self._note_route(rec, s_lu, served="degraded")
+        if rec is not None:
+            rec.event("degraded.cover",
+                      cause=f"{type(cause).__name__}: {cause}",
+                      stale_values=s_key.values[:12])
         from .. import obs
         obs.instant("serve.degraded", cat="serve",
                     args={"pattern": key.pattern[:12],
@@ -606,6 +810,8 @@ class SolveService:
         def on_berr(berr: float) -> None:
             if berr <= limit and np.isfinite(berr):
                 return
+            flight.batch_event("degraded.berr_block",
+                               berr=float(berr))
             with self._lock:
                 already = requested_key in self._degraded_blocked
                 self._degraded_blocked.add(requested_key)

@@ -30,11 +30,29 @@ Usage:
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
+
+# Trace/lower under a lock; compile in parallel.  Concurrent .lower()
+# calls race on jax's GLOBAL inner-jit trace cache: two threads
+# tracing different outer signatures that both call the same inner
+# jit (_where, diagonal, ... inside the group bodies) can each trace
+# it, and the loser embeds an equal-but-NOT-IDENTICAL sub-jaxpr
+# object in its outer jaxpr.  The per-module lowering cache dedupes
+# by object identity, so the raced module lowers DUPLICATE private
+# helper funcs (observed: 6 extra @_where_N) and shifts every
+# subsequent symbol number — same semantics, different serialized
+# bytes, DIFFERENT persistent-cache key than the sequential dispatch
+# computes (the 1-of-38 intermittent warm-key mismatch de-flaked in
+# PR 5 and chased here).  Lowering is GIL-bound Python anyway; the
+# multi-core win of this module is XLA compilation, which releases
+# the GIL — serializing the lower phase costs nothing measurable and
+# makes warm keys deterministic.
+_LOWER_LOCK = threading.Lock()
 
 
 def staged_signatures(sched):
@@ -114,16 +132,18 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
     def compile_factor(item):
         (mb, wb, n_pad, ea_meta, eb_meta, *_), g = item
         a_src, a_dst, one_dst, ea_blocks = g.dev(squeeze=True)[:4]
-        B._staged_factor_group.lower(
-            jax.ShapeDtypeStruct((sched.upd_total + sched.upd_pad,),
-                                 dtype),
-            jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
-            jax.ShapeDtypeStruct((), rdt),
-            sds(a_src), sds(a_dst), sds(one_dst),
-            jax.tree_util.tree_map(sds, ea_blocks),
-            jax.ShapeDtypeStruct((), np.int64),
-            mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
-            eb_meta=eb_meta).compile()
+        with _LOWER_LOCK:
+            lowered = B._staged_factor_group.lower(
+                jax.ShapeDtypeStruct(
+                    (sched.upd_total + sched.upd_pad,), dtype),
+                jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
+                jax.ShapeDtypeStruct((), rdt),
+                sds(a_src), sds(a_dst), sds(one_dst),
+                jax.tree_util.tree_map(sds, ea_blocks),
+                jax.ShapeDtypeStruct((), np.int64),
+                mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
+                eb_meta=eb_meta)
+        lowered.compile()
 
     # X carries promote(factor, rhs) and is real-encoded for complex
     # systems (real/imag halves along the rhs axis — ops/batched._enc)
@@ -136,14 +156,16 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
     def compile_sweep(item):
         (mb, wb, n_pad, ci_a, si_a), g = item
         for kind in kinds:
-            B._staged_sweep_group.lower(
-                jax.ShapeDtypeStruct((sched.n + 1, r_hat), xdt),
-                jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
-                jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
-                jax.ShapeDtypeStruct(ci_a[0], np.dtype(ci_a[1])),
-                jax.ShapeDtypeStruct(si_a[0], np.dtype(si_a[1])),
-                mb=mb, wb=wb, n_pad=n_pad, cplx=x_cplx,
-                kind=kind).compile()
+            with _LOWER_LOCK:
+                lowered = B._staged_sweep_group.lower(
+                    jax.ShapeDtypeStruct((sched.n + 1, r_hat), xdt),
+                    jax.ShapeDtypeStruct((n_pad * mb * wb,), dtype),
+                    jax.ShapeDtypeStruct((n_pad * wb * wb,), dtype),
+                    jax.ShapeDtypeStruct(ci_a[0], np.dtype(ci_a[1])),
+                    jax.ShapeDtypeStruct(si_a[0], np.dtype(si_a[1])),
+                    mb=mb, wb=wb, n_pad=n_pad, cplx=x_cplx,
+                    kind=kind)
+            lowered.compile()
 
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=workers) as ex:

@@ -1,0 +1,413 @@
+"""Request-scoped flight recorder (obs/flight.py) + SLO engine
+(obs/slo.py): per-request stage records through the serve pipeline,
+retention/sampling, the JSONL sink and its trace_export conversion,
+the chaos traceability gate (every non-ok outcome is one lookup from
+a flight record naming its failing stage), and burn-rate accounting
+with exemplar rids."""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options, obs
+from superlu_dist_tpu.obs import flight, slo
+from superlu_dist_tpu.resilience import chaos
+from superlu_dist_tpu.serve import (DegradedResult, ServeConfig,
+                                    ServeRejected, SolveService,
+                                    run_load)
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    """Flight/SLO/chaos are process-global; never leak across tests."""
+    flight.configure(enabled=False)
+    slo.configure("0")
+    chaos.uninstall()
+    yield
+    flight.configure(enabled=False)
+    slo.configure("0")
+    chaos.uninstall()
+
+
+def _svc(**kw):
+    kw.setdefault("backend", "host")
+    return SolveService(ServeConfig(**kw))
+
+
+def _drift(a, factor):
+    return dataclasses.replace(a, data=a.data * factor)
+
+
+# --------------------------------------------------------------------
+# gating: off = no records, no rid, no attributes
+# --------------------------------------------------------------------
+
+def test_off_path_records_nothing():
+    svc = _svc()
+    a = laplacian_2d(6)
+    info = {}
+    fut = svc.submit(a, np.ones(a.n))
+    assert not hasattr(fut, "request_id")
+    assert np.all(np.isfinite(fut.result(timeout=30)))
+    svc.solve(a, np.ones(a.n), info=info)
+    assert info["request_id"] is None
+    assert flight.snapshot() == {"enabled": False}
+    assert flight.start() is None and flight.current() is None
+    svc.close()
+
+
+# --------------------------------------------------------------------
+# the happy-path record: stages, meta, rid plumbing
+# --------------------------------------------------------------------
+
+def test_record_carries_every_stage():
+    flight.configure(enabled=True)
+    svc = _svc()
+    a = laplacian_2d(6)
+    info = {}
+    svc.solve(a, np.ones(a.n), info=info)
+    rid = info["request_id"]
+    assert isinstance(rid, int)
+    rec = flight.get_recorder().lookup(rid)
+    assert rec is not None
+    assert rec["outcome"] == "ok" and rec["failed_stage"] is None
+    assert rec["meta"]["n"] == a.n
+    assert rec["meta"]["tier"] == "float64"
+    stages = [e["stage"] for e in rec["events"]]
+    assert stages[0] == "admit"
+    for want in ("queue", "refine"):
+        assert want in stages, stages
+    assert any(s.startswith("cache.") for s in stages), stages
+    q = next(e for e in rec["events"] if e["stage"] == "queue")
+    assert {"wait_us", "batch", "bucket", "occupancy",
+            "solve_us"} <= set(q)
+    assert rec["e2e_us"] > 0
+    # exported through the unified registry
+    assert obs.snapshot()["flight"]["records"]
+    svc.close()
+
+
+def test_rids_are_monotonic_and_on_the_future():
+    flight.configure(enabled=True)
+    svc = _svc()
+    a = laplacian_2d(6)
+    svc.prefactor(a, Options())
+    f1 = svc.submit(a, np.ones(a.n))
+    f2 = svc.submit(a, np.ones(a.n))
+    assert f2.request_id > f1.request_id
+    f1.result(timeout=30), f2.result(timeout=30)
+    svc.close()
+
+
+def test_ring_bound_and_sampling_keep_every_failure():
+    flight.configure(enabled=True, ring=4, sample=2)
+    r = flight.get_recorder()
+    for _ in range(6):
+        rec = r.start()
+        rec.finish("ok")
+    # rids 1..6: ok kept when (rid-1) % 2 == 0 -> 1, 3, 5
+    kept = [x["rid"] for x in r.records()]
+    assert kept == [1, 3, 5]
+    bad = r.start()
+    bad.finish("poisoned", error=RuntimeError("boom"))
+    kept = r.records()
+    assert kept[-1]["rid"] == 7           # failures ALWAYS retained
+    assert kept[-1]["failed_stage"] == "factor"
+    assert "boom" in kept[-1]["error"]
+    for _ in range(10):
+        r.start().finish("flusher_dead")
+    assert len(r.records()) == 4          # ring bound holds
+    snap = r.snapshot()
+    assert snap["started"] == 17 and snap["finished"] == 17
+    assert snap["by_outcome"]["flusher_dead"] == 10
+
+
+def test_rejected_request_records_admit_stage():
+    flight.configure(enabled=True)
+    svc = _svc(max_queue_depth=2, max_linger_s=0.05)
+    a = laplacian_2d(6)
+    svc.prefactor(a, Options())
+    release = threading.Event()
+    for mb in svc._batchers.values():
+        orig = mb._solve_fn
+        mb._solve_fn = (lambda o: lambda lu, B:
+                        (release.wait(5), o(lu, B))[1])(orig)
+    futs, rej_rid = [], None
+    for _ in range(6):
+        try:
+            futs.append(svc.submit(a, np.ones(a.n)))
+        except ServeRejected as e:
+            rej_rid = e.request_id
+    release.set()
+    for f in futs:
+        f.result(timeout=30)
+    assert rej_rid is not None
+    rec = flight.get_recorder().lookup(rej_rid)
+    assert rec["outcome"] == "rejected"
+    assert rec["failed_stage"] == "admit"
+    svc.close()
+
+
+# --------------------------------------------------------------------
+# failure traceability (the ISSUE-8 gate)
+# --------------------------------------------------------------------
+
+def test_degraded_record_names_factor_stage_and_cover():
+    flight.configure(enabled=True)
+    a = laplacian_2d(6)
+    a2 = _drift(a, 1.0 + 1e-8)
+    svc = _svc()
+    svc.prefactor(a, Options())
+    chaos.install("factor_raise=1", seed=0)
+    info = {}
+    x = svc.solve(a2, np.ones(a.n), info=info)
+    chaos.uninstall()
+    assert isinstance(x, DegradedResult)
+    rec = flight.get_recorder().lookup(info["request_id"])
+    assert rec["outcome"] == "degraded"
+    assert rec["failed_stage"] == "factor"
+    stages = [e["stage"] for e in rec["events"]]
+    assert "degraded.cover" in stages
+    cover = next(e for e in rec["events"]
+                 if e["stage"] == "degraded.cover")
+    assert "cause" in cover
+    # the degraded dispatch still records its queue/solve leg
+    assert "queue" in stages, stages
+    svc.close()
+
+
+def test_chaos_load_every_non_ok_outcome_is_traceable():
+    """The traceability gate: under chaos load, every non-ok status
+    the load generator observed resolves to a flight record whose
+    outcome matches and whose failing stage is named."""
+    flight.configure(enabled=True, ring=512)
+    a = laplacian_2d(6)
+    variants = [_drift(a, 1.0 + i * 1e-8) for i in range(1, 4)]
+    svc = _svc(factor_retries=1, retry_base_s=0.01,
+               breaker_threshold=3, breaker_cooldown_s=0.2,
+               degraded=True, max_linger_s=0.001)
+    svc.prefactor(a, Options())
+    chaos.install("factor_raise=0.5,factor_nan=0.3,"
+                  "flusher_raise=0.15", seed=3)
+    try:
+        report = run_load(svc, [a] + variants, requests=48,
+                          concurrency=6, hot_fraction=0.4, seed=3,
+                          join_timeout_s=120.0)
+    finally:
+        chaos.uninstall()
+    assert report["unresolved"] == 0
+    non_ok = {s: n for s, n in report["by_status"].items()
+              if s != "ok"}
+    assert non_ok, "chaos fired nothing; spec/seed drifted"
+    rec_of = flight.get_recorder().lookup
+    by_status = report["exemplars"]["by_status"]
+    for status, n in non_ok.items():
+        rids = by_status.get(status, [])
+        assert rids, f"{status} has no exemplar rids"
+        for rid in rids:
+            assert rid is not None, f"{status} request without a rid"
+            rec = rec_of(rid)
+            assert rec is not None, f"{status} rid {rid}: no record"
+            assert rec["outcome"] == status, (status, rec)
+            assert rec["failed_stage"], (status, rec)
+    svc.close()
+
+
+def test_flusher_death_and_resubmit_events():
+    flight.configure(enabled=True)
+    svc = _svc(max_linger_s=0.0)
+    a = laplacian_2d(6)
+    svc.prefactor(a, Options())
+    chaos.install("flusher_raise=1", seed=0)
+    info = {}
+    with pytest.raises(Exception):
+        svc.solve(a, np.ones(a.n), info=info)
+    chaos.uninstall()
+    rec = flight.get_recorder().lookup(info["request_id"])
+    assert rec["outcome"] == "flusher_dead"
+    assert rec["failed_stage"] == "batch"
+    stages = [e["stage"] for e in rec["events"]]
+    assert "flusher_died" in stages
+    # the transparent resubmit leg is on the record too (chaos kills
+    # the replacement as well, so the retry is visible then fails)
+    assert "resubmit" in stages, stages
+    svc.close()
+
+
+def test_batchmates_share_a_batch_id():
+    flight.configure(enabled=True)
+    svc = _svc(max_linger_s=0.25)
+    a = laplacian_2d(6)
+    svc.prefactor(a, Options())
+    f1 = svc.submit(a, np.ones(a.n))
+    f2 = svc.submit(a, 2 * np.ones(a.n))
+    f1.result(timeout=30), f2.result(timeout=30)
+    r = flight.get_recorder()
+    q1 = next(e for e in r.lookup(f1.request_id)["events"]
+              if e["stage"] == "queue")
+    q2 = next(e for e in r.lookup(f2.request_id)["events"]
+              if e["stage"] == "queue")
+    assert q1["batch"] == q2["batch"]
+    assert q1["occupancy"] == q2["occupancy"] == 0.25  # 2 of nrhs=8
+    svc.close()
+
+
+# --------------------------------------------------------------------
+# JSONL sink + trace_export per-request tracks
+# --------------------------------------------------------------------
+
+def test_jsonl_sink_and_perfetto_conversion(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    flight.configure(enabled=True, jsonl_path=path)
+    svc = _svc()
+    a = laplacian_2d(6)
+    svc.solve(a, np.ones(a.n))
+    svc.solve(a, 2 * np.ones(a.n))
+    svc.close()
+    flight.configure(enabled=False)      # closes the sink
+    lines = [json.loads(ln) for ln in open(path)]
+    assert len(lines) == 2
+    assert all("rid" in r and "events" in r for r in lines)
+
+    from tools import trace_export
+    events = trace_export.load(path)
+    trace_export.validate_events(events)
+    pids = {e["pid"] for e in events}
+    assert pids == {r["rid"] for r in lines}
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert any("[ok]" in n for n in names)
+    out = str(tmp_path / "flight.trace.json")
+    assert trace_export.main([path, "-o", out]) == 0
+    doc = json.load(open(out))
+    assert doc["traceEvents"]
+
+
+def test_trace_export_rejects_corrupt_flight_log(tmp_path):
+    from tools import trace_export
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"rid": 1, "events": [{"nostage": true}]}\n')
+    assert trace_export.main([str(bad)]) == 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_export.main([str(empty)]) == 1
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_text('{"rid": "not-an-int", "events": []}\n')
+    assert trace_export.main([str(mixed)]) == 1
+
+
+def test_jsonl_sink_self_disables_on_io_error(tmp_path):
+    flight.configure(enabled=True,
+                     jsonl_path=str(tmp_path / "no" / "dir" / "f.jsonl"))
+    r = flight.get_recorder()
+    r.start().finish("ok")               # write fails silently
+    snap = r.snapshot()
+    assert snap["jsonl_error"] is not None
+    assert snap["retained"] == 1         # the ring still has it
+
+
+# --------------------------------------------------------------------
+# SLO engine
+# --------------------------------------------------------------------
+
+def test_slo_spec_parsing():
+    d, o = slo.parse_spec("1")
+    assert d == slo.Objective()
+    d, o = slo.parse_spec("p99_ms=50,avail=0.999,window_s=30")
+    assert d.p99_ms == 50 and d.availability == 0.999 \
+        and d.window_s == 30
+    d, o = slo.parse_spec("p99_ms=100;n<=512:p99_ms=20")
+    assert d.p99_ms == 100 and o == {"n<=512": {"p99_ms": 20.0}}
+    with pytest.raises(ValueError):
+        slo.parse_spec("p99ms=50")       # typo must not silently pass
+
+
+def test_slo_scope_override_applies_per_key():
+    e = slo.SloEngine("p99_ms=100;n<=512:p99_ms=20;float32:avail=0.9")
+    assert e.objective_for("n<=512|float64").p99_ms == 20
+    assert e.objective_for("n<=4096|float64").p99_ms == 100
+    assert e.objective_for("n<=4096|float32").availability == 0.9
+
+
+def test_slo_burn_rate_violation_and_exemplars():
+    e = slo.SloEngine("p99_ms=10,avail=0.9,window_s=60")
+    now = 1000.0
+    for i in range(20):
+        e.observe("n<=512|float64", 0.001, ok=True, rid=i,
+                  now=now + i * 0.01)
+    k = e.snapshot()["keys"]["n<=512|float64"]
+    assert not k["violating"] and k["violations"] == 0
+    # 3 failures in a 23-sample window: err ~13% > allowed 10%
+    for i in range(3):
+        e.observe("n<=512|float64", 0.5, ok=False, rid=100 + i,
+                  now=now + 1 + i * 0.01)
+    k = e.snapshot()["keys"]["n<=512|float64"]
+    assert k["violating"] and k["violations"] >= 1
+    assert k["burn_rate_availability"] > 1.0
+    failed_rids = [x["rid"] for x in k["exemplars"]["failed"]]
+    assert set(failed_rids) <= {100, 101, 102} and failed_rids
+    # slow-but-ok exemplars carry the worst latencies
+    e2 = slo.SloEngine("p99_ms=10,avail=0.5,window_s=60")
+    for i in range(50):
+        e2.observe("k", 0.5 if i % 2 else 0.001, ok=True, rid=i,
+                   now=now + i * 0.01)
+    k2 = e2.snapshot()["keys"]["k"]
+    assert k2["burn_rate_latency"] > 1.0 and k2["violating"]
+    assert k2["exemplars"]["slow"][0]["ms"] >= 499
+
+
+def test_slo_window_slides():
+    e = slo.SloEngine("p99_ms=10,avail=0.9,window_s=1")
+    for i in range(5):
+        e.observe("k", 0.5, ok=False, rid=i, now=100.0 + i * 0.01)
+    assert e.snapshot()["keys"]["k"]["violating"]
+    e.observe("k", 0.001, ok=True, rid=9, now=200.0)
+    k = e.snapshot()["keys"]["k"]
+    assert k["window_count"] == 1 and not k["violating"]
+    assert k["failed"] == 5              # lifetime counter survives
+
+
+def test_slo_feeds_from_service_and_dumps():
+    slo.configure("p99_ms=1000,avail=0.99,window_s=60")
+    flight.configure(enabled=True)
+    svc = _svc()
+    a = laplacian_2d(6)
+    svc.solve(a, np.ones(a.n))
+    snap = obs.snapshot()["slo"]
+    (key,) = snap["keys"].keys()
+    assert key == "n<=512|float64"
+    assert snap["keys"][key]["requests"] == 1
+    assert any(line.startswith("slu_slo_keys_")
+               for line in obs.dump_text().splitlines())
+    svc.close()
+
+
+def test_slo_counts_rejections_as_failures():
+    slo.configure("p99_ms=1000,avail=0.99,window_s=60")
+    svc = _svc(max_queue_depth=1, max_linger_s=0.05)
+    a = laplacian_2d(6)
+    svc.prefactor(a, Options())
+    release = threading.Event()
+    for mb in svc._batchers.values():
+        orig = mb._solve_fn
+        mb._solve_fn = (lambda o: lambda lu, B:
+                        (release.wait(5), o(lu, B))[1])(orig)
+    futs = []
+    rejected = 0
+    for _ in range(4):
+        try:
+            futs.append(svc.submit(a, np.ones(a.n)))
+        except ServeRejected:
+            rejected += 1
+    release.set()
+    for f in futs:
+        f.result(timeout=30)
+    assert rejected
+    time.sleep(0.05)                      # done-callbacks drain
+    snap = slo.snapshot()
+    assert snap["keys"]["unrouted"]["failed"] == rejected
+    svc.close()

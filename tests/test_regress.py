@@ -1,0 +1,214 @@
+"""Perf-regression sentinel (tools/regress.py): green on the
+committed record history vs the committed BASELINES.json, red on a
+synthetically degraded record, tolerant of missing-platform records
+(TPU lines absent on a CPU-only box)."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from tools import regress
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORD_FILES = ("SERVE_LATENCY.jsonl", "SOLVE_LATENCY.jsonl",
+                "PREC_AB.jsonl", "CHAOS.jsonl", "BASELINES.json")
+
+
+def _copy_repo_records(tmp_path, include=RECORD_FILES):
+    for name in include:
+        src = os.path.join(ROOT, name)
+        if os.path.exists(src):
+            shutil.copy(src, tmp_path / name)
+    return str(tmp_path)
+
+
+def _append(tmp_path, name, rec):
+    with open(tmp_path / name, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _baseline(platform, check):
+    doc = json.load(open(os.path.join(ROOT, "BASELINES.json")))
+    return doc["platforms"][platform][check]
+
+
+# --------------------------------------------------------------------
+# the committed contract
+# --------------------------------------------------------------------
+
+def test_baselines_are_committed_and_parse():
+    path = os.path.join(ROOT, "BASELINES.json")
+    assert os.path.exists(path), (
+        "BASELINES.json must be committed (seed via "
+        "`python -m tools.regress --update`)")
+    doc = json.load(open(path))
+    assert doc["version"] == 1
+    assert "cpu" in doc["platforms"]
+    assert "serve" in doc["platforms"]["cpu"]
+
+
+def test_committed_history_is_green():
+    findings, passed = regress.check_repo(ROOT)
+    fails = [f for f in findings if f["status"] == "fail"]
+    assert passed and not fails, fails
+    # and it actually checked things (not all-skip vacuity)
+    assert any(f["status"] == "ok" for f in findings)
+
+
+def test_cli_green_on_head():
+    assert regress.main(["--root", ROOT]) == 0
+
+
+# --------------------------------------------------------------------
+# synthetic regressions must go red
+# --------------------------------------------------------------------
+
+def test_throughput_regression_is_red(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    base = _baseline("cpu", "serve")
+    _append(tmp_path, "SERVE_LATENCY.jsonl", {
+        "mode": "serve", "platform": "cpu",
+        "solves_per_s": base["solves_per_s"] * 0.1,
+        "p95_ms": base["p95_ms"], "p99_ms": base["p99_ms"],
+        "recompiles_under_load": 0})
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    (f,) = [f for f in findings if f["status"] == "fail"]
+    assert f["check"] == "serve" and f["metric"] == "solves_per_s"
+    assert regress.main(["--root", root]) == 1
+
+
+def test_latency_and_recompile_regressions_are_red(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    base = _baseline("cpu", "serve")
+    _append(tmp_path, "SERVE_LATENCY.jsonl", {
+        "mode": "serve", "platform": "cpu",
+        "solves_per_s": base["solves_per_s"],
+        "p95_ms": base["p95_ms"],
+        "p99_ms": base["p99_ms"] * 10,     # past the 2x ceiling
+        "recompiles_under_load": 3})       # and the zero pin
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    failed = {f["metric"] for f in findings if f["status"] == "fail"}
+    assert failed == {"p99_ms", "recompiles_under_load"}
+
+
+def test_chaos_unresolved_regression_is_red(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    _append(tmp_path, "CHAOS.jsonl", {
+        "mode": "chaos", "platform": "cpu",
+        "unresolved": 2, "by_status": {"ok": 90, "nonfinite": 1},
+        "gate": {"passed": False}})
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    failed = {f["metric"] for f in findings if f["status"] == "fail"}
+    assert {"unresolved", "nonfinite", "gate.passed"} <= failed
+
+
+def test_berr_class_regression_is_red(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    base = _baseline("cpu", "prec_ab")["berr"]
+    arm = sorted(base)[0]
+    _append(tmp_path, "PREC_AB.jsonl", {
+        "mode": "prec_ab", "platform": "cpu",
+        "arms": {arm: {"berr": base[arm] * 1e4}}})   # left its class
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    (f,) = [f for f in findings if f["status"] == "fail"]
+    assert f["metric"] == f"berr.{arm}"
+    # same-class drift (2x) stays green
+    root2 = tmp_path / "ok"
+    root2.mkdir()
+    _copy_repo_records(root2)
+    _append(root2, "PREC_AB.jsonl", {
+        "mode": "prec_ab", "platform": "cpu",
+        "arms": {arm: {"berr": base[arm] * 2}}})
+    _, passed = regress.check_repo(str(root2))
+    assert passed
+
+
+def test_flight_overhead_regression_is_red(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    _append(tmp_path, "SERVE_LATENCY.jsonl", {
+        "mode": "flight_ab", "platform": "cpu",
+        "overhead_frac": 0.2})
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    (f,) = [f for f in findings if f["status"] == "fail"]
+    assert f["check"] == "flight_ab"
+
+
+# --------------------------------------------------------------------
+# tolerance for what a box cannot measure
+# --------------------------------------------------------------------
+
+def test_missing_platform_records_are_skipped_not_failed(tmp_path):
+    # a box with baselines but NO records at all (e.g. a fresh CPU
+    # checkout without the TPU artifacts): every check skips
+    shutil.copy(os.path.join(ROOT, "BASELINES.json"),
+                tmp_path / "BASELINES.json")
+    findings, passed = regress.check_repo(str(tmp_path))
+    assert passed
+    assert all(f["status"] == "skip" for f in findings)
+
+
+def test_unknown_history_is_unbaselined_not_failed(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    _append(tmp_path, "SERVE_LATENCY.jsonl", {
+        "mode": "serve", "platform": "exotic_accel",
+        "solves_per_s": 1.0})
+    findings, passed = regress.check_repo(root)
+    assert passed
+    assert any(f["status"] == "unbaselined"
+               and f["platform"] == "exotic_accel" for f in findings)
+
+
+def test_missing_baselines_file_passes_with_skip(tmp_path):
+    findings, passed = regress.check_repo(str(tmp_path))
+    assert passed and findings[0]["status"] == "skip"
+
+
+def test_corrupt_baselines_fail(tmp_path):
+    (tmp_path / "BASELINES.json").write_text("{not json")
+    findings, passed = regress.check_repo(str(tmp_path))
+    assert not passed
+
+
+# --------------------------------------------------------------------
+# the re-baseline workflow
+# --------------------------------------------------------------------
+
+def test_update_seeds_baselines_from_history(tmp_path):
+    root = _copy_repo_records(
+        tmp_path, include=("SERVE_LATENCY.jsonl",
+                           "SOLVE_LATENCY.jsonl", "PREC_AB.jsonl",
+                           "CHAOS.jsonl"))
+    assert regress.main(["--root", root, "--update"]) == 0
+    doc = json.load(open(tmp_path / "BASELINES.json"))
+    assert "cpu" in doc["platforms"]
+    assert doc["platforms"]["cpu"]["serve"]["solves_per_s"] > 0
+    # freshly seeded baselines gate their own history green
+    assert regress.main(["--root", root]) == 0
+
+
+def test_update_preserves_tuned_tolerances(tmp_path):
+    root = _copy_repo_records(tmp_path)
+    doc = json.load(open(tmp_path / "BASELINES.json"))
+    doc["tolerances"]["throughput_drop_frac"] = 0.123
+    (tmp_path / "BASELINES.json").write_text(json.dumps(doc))
+    assert regress.main(["--root", root, "--update"]) == 0
+    doc2 = json.load(open(tmp_path / "BASELINES.json"))
+    assert doc2["tolerances"]["throughput_drop_frac"] == 0.123
+
+
+def test_median_baseline_resists_one_outlier():
+    hist = {"cpu": {"serve": [
+        {"solves_per_s": 100.0, "p95_ms": 10.0, "p99_ms": 20.0},
+        {"solves_per_s": 5.0, "p95_ms": 500.0, "p99_ms": 900.0},
+        {"solves_per_s": 110.0, "p95_ms": 11.0, "p99_ms": 21.0},
+    ]}}
+    base = regress.build_baselines(hist)
+    assert base["platforms"]["cpu"]["serve"]["solves_per_s"] == 100.0
+    assert base["platforms"]["cpu"]["serve"]["p95_ms"] == 11.0

@@ -86,18 +86,19 @@ plan = plan_factorization(a, Options(factor_dtype="float32"))
 """
 
 _WARM_SCRIPT = _COMMON + r"""
-# workers=1 ON PURPOSE: with a parallel warmup (workers>=2), 1 of the
-# 38 staged programs INTERMITTENTLY lands in the persistent cache
-# under a different key than the sequential dispatch computes (~1/3
-# of runs on this box; measured 6/6 stable at workers=1, dispatch
-# side verified cross-process stable — a second dispatch adds zero
-# cache files).  That is a warm-side thread-interleaving dependence
-# in the lowered program's cache key — a real (mild: one extra
-# compile per fleet boot) product issue worth chasing in
-# utils/warmup.py / jax lowering, but it is NOT the contract under
-# test here, which is warmup-vs-dispatch SIGNATURE agreement.  Keep
-# this script's warmup serial so the 38/38 pin stays deterministic.
-rep = warmup_staged(plan, dtype="float32", workers=1)
+# workers=2: PARALLEL warmup, restored after the PR-5 de-flake.  The
+# intermittent 1-of-38 key mismatch was chased to its root: with
+# workers>=2, concurrent .lower() calls raced on jax's global
+# inner-jit trace cache, so a raced outer jaxpr embedded
+# equal-but-not-identical sub-jaxpr objects and lowered DUPLICATE
+# private helper funcs (@_where_N) — same semantics, different
+# serialized module bytes, different persistent-cache key than the
+# sequential dispatch computes.  utils/warmup.py now serializes the
+# trace/lower phase behind _LOWER_LOCK (lowering is GIL-bound; the
+# parallel win is XLA compilation, which releases the GIL), making
+# warm keys deterministic at any worker count — verified 10/10
+# mismatch-free at workers=2 vs ~1/3 flaky before the fix.
+rep = warmup_staged(plan, dtype="float32", workers=2)
 print("RESULT " + json.dumps(rep))
 """
 

@@ -1,0 +1,455 @@
+"""Perf-regression sentinel over the committed measurement history.
+
+Five rounds of records are committed (SERVE_LATENCY.jsonl,
+SOLVE_LATENCY.jsonl, PREC_AB.jsonl, CHAOS.jsonl, BENCH_r*.json /
+TPU_BENCH_LIVE.json) but until this tool nothing turned that history
+into a GATE: a perf loss — the silent-regression failure mode the
+HPL-exascale pipelining work warns about (PAPERS.md, arxiv
+2304.10397) — would land invisibly.  This module maintains a
+committed `BASELINES.json` (per-platform: CPU rehearsal and TPU
+records interleave in the same files) and fails when the latest
+record for any (platform, check) regresses past a configurable
+tolerance:
+
+  * serve      — solves/s floor, p95/p99 ceilings, recompiles == 0
+  * flight_ab  — flight-recorder overhead within the declared frac
+  * solve      — per-nrhs per-rhs latency ceilings
+  * prec_ab    — per-arm berr must stay in its accuracy CLASS
+                 (ratio-bounded: a berr that grows 100x left its
+                 class; absolute drift within a class is noise)
+  * chaos      — unresolved == 0, nonfinite == 0, untyped == 0,
+                 gate.passed
+  * bench      — GFLOP/s floor
+
+Usage:
+
+    python -m tools.regress             # gate; exit 1 on regression
+    python -m tools.regress --json      # machine-readable findings
+    python -m tools.regress --update    # re-baseline from history
+
+Baseline-update workflow (DESIGN.md §15): a LEGITIMATE perf change
+ships with `--update` in the same commit — the new BASELINES.json is
+reviewed next to the code that moved the numbers.  A regression is
+the same diff WITHOUT a code story: the gate (serve_bench post-run,
+the tpu_fire.sh arm, tests/test_regress.py in tier-1) rejects it
+before it lands.  Missing-platform records are tolerated (TPU lines
+are absent on the CPU box): those checks report `skip`, never fail.
+
+Numeric baselines are seeded as the MEDIAN of the trailing window of
+committed records per (platform, check, metric) — robust to the
+timeshared rehearsal box's scheduler noise; the gate compares the
+LATEST record against median±tolerance.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+# trailing records per (platform, check) the baseline median is
+# computed over
+_WINDOW = 5
+
+DEFAULT_TOLERANCES = {
+    # latest throughput may drop to (1 - frac) * baseline before the
+    # gate fires.  Generous: the CPU rehearsal box swings same-moment
+    # A/Bs ~2x under scheduler noise (SERVE_LATENCY.jsonl history).
+    "throughput_drop_frac": 0.5,
+    # latest latency may rise to (1 + frac) * baseline
+    "latency_rise_frac": 1.0,
+    # berr may grow by this RATIO before it "left its class"
+    "berr_class_ratio": 100.0,
+    "gflops_drop_frac": 0.5,
+    # flight-recorder on/off throughput gap (the ISSUE-8 overhead
+    # acceptance: within 5% on a same-box same-moment A/B)
+    "flight_overhead_frac": 0.05,
+}
+
+
+# --------------------------------------------------------------------
+# record ingestion
+# --------------------------------------------------------------------
+
+def _read_jsonl(path: str) -> list[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue            # corrupt line: not this gate's job
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _bench_records(root: str) -> list[dict]:
+    """GFLOP/s records from TPU_BENCH_LIVE.json and the BENCH_r*.json
+    driver wrappers (whose bench line hides in the `tail` text)."""
+    out = []
+
+    def _adopt(rec, src):
+        if not isinstance(rec, dict) or rec.get("value") is None:
+            return
+        if rec.get("unit") != "GFLOP/s":
+            return
+        if rec.get("measurement_invalid"):
+            return
+        out.append({"gflops": float(rec["value"]),
+                    "platform": ("cpu" if rec.get("cpu_fallback")
+                                 else "tpu"),
+                    "src": src})
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            doc = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        if "value" in doc:
+            _adopt(doc, os.path.basename(path))
+            continue
+        for ln in str(doc.get("tail", "")).splitlines():
+            ln = ln.strip()
+            if ln.startswith("{") and '"metric"' in ln:
+                try:
+                    _adopt(json.loads(ln), os.path.basename(path))
+                except ValueError:
+                    pass
+    live = os.path.join(root, "TPU_BENCH_LIVE.json")
+    if os.path.exists(live):
+        try:
+            _adopt(json.load(open(live)), "TPU_BENCH_LIVE.json")
+        except (OSError, ValueError):
+            pass
+    return out
+
+
+def gather(root: str) -> dict:
+    """history[platform][check] -> list of records, oldest first."""
+    hist: dict = {}
+
+    def add(platform, check, rec):
+        if not platform:
+            return
+        hist.setdefault(platform, {}).setdefault(check, []).append(rec)
+
+    for rec in _read_jsonl(os.path.join(root, "SERVE_LATENCY.jsonl")):
+        mode = rec.get("mode")
+        if mode == "serve":
+            add(rec.get("platform"), "serve", rec)
+        elif mode == "flight_ab":
+            add(rec.get("platform"), "flight_ab", rec)
+    for rec in _read_jsonl(os.path.join(root, "SOLVE_LATENCY.jsonl")):
+        if rec.get("per_rhs_ms") is not None:
+            add(rec.get("platform"), f"solve.nrhs{rec.get('nrhs')}",
+                rec)
+    for rec in _read_jsonl(os.path.join(root, "PREC_AB.jsonl")):
+        if rec.get("mode") == "prec_ab":
+            add(rec.get("platform"), "prec_ab", rec)
+    for rec in _read_jsonl(os.path.join(root, "CHAOS.jsonl")):
+        if rec.get("mode") == "chaos":
+            add(rec.get("platform"), "chaos", rec)
+    for rec in _bench_records(root):
+        add(rec.get("platform"), "bench", rec)
+    return hist
+
+
+# --------------------------------------------------------------------
+# checking
+# --------------------------------------------------------------------
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return None
+    mid = n // 2
+    return (vals[mid] if n % 2
+            else 0.5 * (vals[mid - 1] + vals[mid]))
+
+
+def _finding(platform, check, metric, value, baseline, limit, status,
+             why=""):
+    return {"platform": platform, "check": check, "metric": metric,
+            "value": value, "baseline": baseline, "limit": limit,
+            "status": status, "why": why}
+
+
+def _num(rec, key):
+    v = rec.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def check(history: dict, baselines: dict) -> list[dict]:
+    """Latest record per (platform, check) vs the committed baseline.
+    Returns findings; status 'fail' means regression.  A platform or
+    check present in baselines but absent from history is 'skip'
+    (missing-platform tolerance), and vice versa ('unbaselined' —
+    run --update to adopt it)."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(baselines.get("tolerances", {}))
+    findings: list[dict] = []
+    b_platforms = baselines.get("platforms", {})
+
+    def floor_check(p, chk, metric, latest, base, frac):
+        if base is None:
+            return
+        if latest is None:
+            findings.append(_finding(p, chk, metric, None, base, None,
+                                     "skip", "metric absent"))
+            return
+        limit = base * (1.0 - frac)
+        ok = latest >= limit
+        findings.append(_finding(
+            p, chk, metric, latest, base, limit,
+            "ok" if ok else "fail",
+            "" if ok else f"{metric} fell below "
+            f"{(1 - frac):.0%} of baseline"))
+
+    def ceil_check(p, chk, metric, latest, base, frac_or_ratio,
+                   ratio=False):
+        if base is None:
+            return
+        if latest is None:
+            findings.append(_finding(p, chk, metric, None, base, None,
+                                     "skip", "metric absent"))
+            return
+        limit = (base * frac_or_ratio if ratio
+                 else base * (1.0 + frac_or_ratio))
+        ok = latest <= limit
+        findings.append(_finding(
+            p, chk, metric, latest, base, limit,
+            "ok" if ok else "fail",
+            "" if ok else f"{metric} rose past the baseline limit"))
+
+    def zero_check(p, chk, metric, latest, why):
+        if latest is None:
+            return
+        ok = latest == 0
+        findings.append(_finding(p, chk, metric, latest, 0, 0,
+                                 "ok" if ok else "fail",
+                                 "" if ok else why))
+
+    for p, checks in sorted(b_platforms.items()):
+        h = history.get(p, {})
+        for chk, base in sorted(checks.items()):
+            recs = h.get(chk)
+            if not recs:
+                findings.append(_finding(p, chk, None, None, None,
+                                         None, "skip",
+                                         "no record on this box"))
+                continue
+            latest = recs[-1]
+            if chk == "serve":
+                floor_check(p, chk, "solves_per_s",
+                            _num(latest, "solves_per_s"),
+                            base.get("solves_per_s"),
+                            tol["throughput_drop_frac"])
+                for m in ("p95_ms", "p99_ms"):
+                    ceil_check(p, chk, m, _num(latest, m),
+                               base.get(m), tol["latency_rise_frac"])
+                zero_check(p, chk, "recompiles_under_load",
+                           _num(latest, "recompiles_under_load"),
+                           "jit recompiled under load")
+            elif chk == "flight_ab":
+                v = _num(latest, "overhead_frac")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "overhead_frac", None, None, None,
+                        "skip", "metric absent"))
+                else:
+                    limit = tol["flight_overhead_frac"]
+                    ok = v <= limit
+                    findings.append(_finding(
+                        p, chk, "overhead_frac", v, 0.0, limit,
+                        "ok" if ok else "fail",
+                        "" if ok else "flight recorder overhead past "
+                        "the declared budget"))
+            elif chk.startswith("solve.nrhs"):
+                ceil_check(p, chk, "per_rhs_ms",
+                           _num(latest, "per_rhs_ms"),
+                           base.get("per_rhs_ms"),
+                           tol["latency_rise_frac"])
+            elif chk == "prec_ab":
+                arms = latest.get("arms", {})
+                for arm, b_arm in sorted(base.get("berr", {}).items()):
+                    v = arms.get(arm, {}).get("berr")
+                    ceil_check(p, chk, f"berr.{arm}",
+                               float(v) if v is not None else None,
+                               b_arm, tol["berr_class_ratio"],
+                               ratio=True)
+            elif chk == "chaos":
+                zero_check(p, chk, "unresolved",
+                           _num(latest, "unresolved"),
+                           "a request hung (no status)")
+                by = latest.get("by_status", {})
+                zero_check(p, chk, "nonfinite",
+                           float(by.get("nonfinite", 0)),
+                           "a non-finite result was served")
+                zero_check(p, chk, "error",
+                           float(by.get("error", 0)),
+                           "an untyped error escaped the taxonomy")
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the chaos gate itself failed"))
+            elif chk == "bench":
+                floor_check(p, chk, "gflops",
+                            _num(latest, "gflops"),
+                            base.get("gflops"),
+                            tol["gflops_drop_frac"])
+    # history the baselines don't know about (informational only)
+    for p, checks in sorted(history.items()):
+        for chk in sorted(checks):
+            if chk not in b_platforms.get(p, {}):
+                findings.append(_finding(p, chk, None, None, None,
+                                         None, "unbaselined",
+                                         "run --update to adopt"))
+    return findings
+
+
+# --------------------------------------------------------------------
+# baseline maintenance
+# --------------------------------------------------------------------
+
+def build_baselines(history: dict, tolerances: dict | None = None,
+                    ts: str | None = None) -> dict:
+    """Seed/refresh baselines from the committed history: per
+    (platform, check), the median of the trailing _WINDOW records per
+    metric.  Structural zero-gates (recompiles, chaos counters) carry
+    no numbers — presence of the check is the declaration."""
+    platforms: dict = {}
+    for p, checks in sorted(history.items()):
+        for chk, recs in sorted(checks.items()):
+            win = recs[-_WINDOW:]
+            dst = platforms.setdefault(p, {})
+            if chk == "serve":
+                dst[chk] = {
+                    m: _median([v for r in win
+                                if (v := _num(r, m)) is not None])
+                    for m in ("solves_per_s", "p95_ms", "p99_ms")}
+            elif chk == "flight_ab":
+                dst[chk] = {}
+            elif chk.startswith("solve.nrhs"):
+                dst[chk] = {"per_rhs_ms": _median(
+                    [v for r in win
+                     if (v := _num(r, "per_rhs_ms")) is not None])}
+            elif chk == "prec_ab":
+                berr: dict = {}
+                for r in win:
+                    for arm, d in r.get("arms", {}).items():
+                        if d.get("berr") is not None:
+                            berr.setdefault(arm, []).append(
+                                float(d["berr"]))
+                dst[chk] = {"berr": {a: _median(v)
+                                     for a, v in sorted(berr.items())}}
+            elif chk == "chaos":
+                dst[chk] = {}
+            elif chk == "bench":
+                dst[chk] = {"gflops": _median(
+                    [v for r in win
+                     if (v := _num(r, "gflops")) is not None])}
+    return {"version": 1,
+            "updated_ts": ts,
+            "tolerances": dict(tolerances or DEFAULT_TOLERANCES),
+            "platforms": platforms}
+
+
+# --------------------------------------------------------------------
+# driver surface
+# --------------------------------------------------------------------
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_repo(root: str | None = None,
+               baselines_path: str | None = None) -> tuple[list, bool]:
+    """(findings, passed) for the records in `root` — the importable
+    gate serve_bench and the tier-1 test call."""
+    root = root or repo_root()
+    baselines_path = baselines_path or os.path.join(root,
+                                                    "BASELINES.json")
+    try:
+        baselines = json.load(open(baselines_path))
+    except OSError:
+        return ([_finding(None, None, None, None, None, None, "skip",
+                          f"no baselines at {baselines_path}")], True)
+    except ValueError as e:
+        return ([_finding(None, None, None, None, None, None, "fail",
+                          f"corrupt baselines: {e}")], False)
+    findings = check(gather(root), baselines)
+    passed = not any(f["status"] == "fail" for f in findings)
+    return findings, passed
+
+
+def format_findings(findings) -> str:
+    lines = []
+    for f in findings:
+        if f["status"] == "ok":
+            continue
+        loc = "/".join(str(x) for x in (f["platform"], f["check"],
+                                        f["metric"]) if x)
+        lines.append(f"[{f['status'].upper():5s}] {loc}: "
+                     f"value={f['value']} baseline={f['baseline']} "
+                     f"limit={f['limit']} {f['why']}")
+    counts: dict = {}
+    for f in findings:
+        counts[f["status"]] = counts.get(f["status"], 0) + 1
+    lines.append("regress: " + " ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = repo_root()
+    if "--root" in argv:
+        i = argv.index("--root")
+        root = argv[i + 1]
+        del argv[i:i + 2]
+    baselines_path = os.path.join(root, "BASELINES.json")
+    if "--baselines" in argv:
+        i = argv.index("--baselines")
+        baselines_path = argv[i + 1]
+        del argv[i:i + 2]
+    if "--update" in argv:
+        import time
+        old_tol = None
+        try:
+            old_tol = json.load(open(baselines_path)).get("tolerances")
+        except (OSError, ValueError):
+            pass
+        base = build_baselines(
+            gather(root), tolerances=old_tol,
+            ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+        tmp = baselines_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(base, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, baselines_path)
+        print(f"regress: baselines rewritten -> {baselines_path} "
+              f"({sum(len(v) for v in base['platforms'].values())} "
+              f"checks)")
+        return 0
+    findings, passed = check_repo(root, baselines_path)
+    if "--json" in argv:
+        print(json.dumps({"passed": passed, "findings": findings},
+                         indent=1))
+    else:
+        print(format_findings(findings))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,19 @@
 plus the chaos gate (`--chaos [SPEC]`), which runs the standard load
 under fault injection (resilience/chaos.py) and gates on zero hangs
 and zero silent wrong answers, appending a record to CHAOS.jsonl
-(SLU_CHAOS_OUT).
+(SLU_CHAOS_OUT), and the flight-recorder overhead A/B
+(`--flight-ab`), which measures SLU_FLIGHT=1 against flight-off on
+the same box at the same moment (interleaved trials, median ratio)
+and appends a `flight_ab` record gating the <=5% overhead contract.
+
+The standard run drives the load with the flight recorder ON (unless
+SLU_FLIGHT=0) and the SLO engine declared (SLU_SLO or a default
+declaration), so the committed record carries EXEMPLARS — the request
+IDs of the p99/worst requests and of every non-ok status — plus the
+per-(n-bucket, dtype-tier) SLO verdicts.  After appending its record
+it runs the perf-regression sentinel (tools/regress.py) against the
+committed BASELINES.json and fails the process on regression
+(SLU_REGRESS=0 skips).
 
 Factors one hot matrix (3D Laplacian, k=SLU_SERVE_K), then measures:
 
@@ -56,6 +68,19 @@ def _jax_env():
     return repo, dev
 
 
+def _observability_on():
+    """Flight recorder + SLO declaration for bench loads: on by
+    default so committed records carry exemplars and SLO verdicts;
+    SLU_FLIGHT=0 / SLU_SLO=0 opt out explicitly."""
+    from superlu_dist_tpu.obs import flight, slo
+    if os.environ.get("SLU_FLIGHT") != "0":
+        flight.configure(enabled=True)
+    if os.environ.get("SLU_SLO", "") != "0":
+        slo.configure(os.environ.get("SLU_SLO")
+                      or "p99_ms=100,avail=0.99,window_s=300")
+    return flight, slo
+
+
 def run(argv=()):
     repo, dev = _jax_env()
 
@@ -64,6 +89,7 @@ def run(argv=()):
                                         run_load, solve_jit_cache_size)
     from superlu_dist_tpu.utils.testmat import laplacian_3d
 
+    flight, slo = _observability_on()
     k = int(os.environ.get("SLU_SERVE_K", "8"))
     concurrency = int(os.environ.get("SLU_SERVE_CONCURRENCY", "16"))
     requests = int(os.environ.get("SLU_SERVE_REQUESTS", "192"))
@@ -168,6 +194,13 @@ def run(argv=()):
                              if jit_before >= 0 else None),
         "compile_misses_total": misses_after,
         "warmup_s": t_warm,
+        # exemplars: the p99/worst rids + every non-ok status's rids —
+        # one lookup from their flight records (SLU_FLIGHT_JSONL /
+        # obs.snapshot()['flight'])
+        "exemplars": report.get("exemplars"),
+        "flight": {k2: v for k2, v in flight.snapshot().items()
+                   if k2 != "records"},
+        "slo": slo.snapshot(),
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -183,11 +216,127 @@ def run(argv=()):
     return rec
 
 
+def run_flight_ab(argv=()):
+    """Flight-recorder overhead A/B: the same load with the recorder
+    OFF vs ON, interleaved on the same service at the same moment so
+    box noise hits both arms alike; the MEDIAN per-arm throughput
+    ratio is the measurement.  Appends a `flight_ab` record to
+    SLU_SERVE_OUT and fails (exit 1) when the on-arm loses more than
+    SLU_FLIGHT_MAX_OVERHEAD (default 0.05 — the ISSUE-8 acceptance:
+    within 5%, and strictly one flag check on the path when off)."""
+    repo, dev = _jax_env()
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.obs import flight
+    from superlu_dist_tpu.serve import (ServeConfig, SolveService,
+                                        run_load)
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    k = int(os.environ.get("SLU_SERVE_K", "8"))
+    concurrency = int(os.environ.get("SLU_SERVE_CONCURRENCY", "16"))
+    requests = int(os.environ.get("SLU_SERVE_REQUESTS", "192"))
+    trials = int(os.environ.get("SLU_FLIGHT_AB_TRIALS", "5"))
+    budget = float(os.environ.get("SLU_FLIGHT_MAX_OVERHEAD", "0.05"))
+    out_path = os.environ.get(
+        "SLU_SERVE_OUT", os.path.join(repo, "SERVE_LATENCY.jsonl"))
+
+    a = laplacian_3d(k)
+    svc = SolveService(ServeConfig(
+        max_queue_depth=max(64, 4 * requests)))
+    print(f"# flight A/B: factoring n={a.n} (k={k}) ...",
+          file=sys.stderr)
+    key = svc.prefactor(a, Options(factor_dtype="float64"))
+
+    # interleaved pairs with ALTERNATING arm order (the box warms
+    # monotonically through the run; a fixed order would bias one
+    # arm); the measurement is the median of per-pair on/off ratios,
+    # so slow drift cancels within each pair
+    rates: dict = {"off": [], "on": []}
+    ratios = []
+    for t in range(trials):
+        order = ("off", "on") if t % 2 == 0 else ("on", "off")
+        pair = {}
+        for arm in order:
+            flight.configure(enabled=(arm == "on"))
+            rep = run_load(svc, [key], requests=requests,
+                           concurrency=concurrency,
+                           hot_fraction=1.0, seed=t)
+            pair[arm] = rep["solves_per_s"]
+            rates[arm].append(rep["solves_per_s"])
+            print(f"# trial {t} {arm}: "
+                  f"{rep['solves_per_s']:.1f} solves/s",
+                  file=sys.stderr)
+        if pair["off"] > 0 and pair["on"] > 0:
+            ratios.append(pair["on"] / pair["off"])
+        else:
+            # an arm that completed zero solves (total deadline
+            # blowout on an overloaded box) is a failed trial, not a
+            # division — it is excluded from the median and reported
+            print(f"# trial {t}: zero-throughput arm, pair discarded",
+                  file=sys.stderr)
+    flight.configure(enabled=False)
+    svc.close()
+
+    med_off = sorted(rates["off"])[trials // 2]
+    med_on = sorted(rates["on"])[trials // 2]
+    if ratios:
+        med_ratio = sorted(ratios)[len(ratios) // 2]
+        overhead = max(0.0, 1.0 - med_ratio)
+    else:
+        overhead = 1.0          # no valid pair: fail loudly below
+    rec = {
+        "mode": "flight_ab",
+        "n": a.n, "k": k,
+        "concurrency": concurrency,
+        "requests": requests,
+        "trials": trials,
+        "solves_per_s_off": rates["off"],
+        "solves_per_s_on": rates["on"],
+        "median_off": med_off,
+        "median_on": med_on,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "overhead_frac": round(overhead, 4),
+        "budget_frac": budget,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    line = json.dumps(rec)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    if overhead > budget:
+        print(f"# FLIGHT OVERHEAD REGRESSION: {overhead:.1%} > "
+              f"{budget:.1%} (off {med_off:.1f}, on {med_on:.1f})",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return rec
+
+
 # default chaos spec: every failure class the resilience layer claims
 # to contain, all at once — lead-factorization raises, NaN factors,
 # persisted-entry bit flips, flusher death, dispatch latency
 DEFAULT_CHAOS_SPEC = ("factor_raise=0.3,factor_nan=0.3,store_flip=1,"
                       "flusher_raise=0.08,latency=0.2:0.003")
+
+
+def _traceability(flight, report) -> dict:
+    """Cross-check the load report's non-ok rids against the flight
+    ring: each must resolve to a record with a failing stage."""
+    rec = flight.get_recorder()
+    if rec is None:
+        return {"enabled": False}
+    by_status = report.get("exemplars", {}).get("by_status", {})
+    missing = []
+    checked = 0
+    for status, rids in by_status.items():
+        for rid in rids:
+            checked += 1
+            fr = rec.lookup(rid) if rid is not None else None
+            if fr is None or not fr.get("failed_stage"):
+                missing.append({"status": status, "rid": rid})
+    return {"enabled": True, "non_ok_checked": checked,
+            "missing": missing, "complete": not missing}
 
 
 def run_chaos(spec=None, argv=()):
@@ -208,6 +357,7 @@ def run_chaos(spec=None, argv=()):
                                         SolveService, run_load)
     from superlu_dist_tpu.utils.testmat import laplacian_3d
 
+    flight, slo = _observability_on()
     spec = (spec or os.environ.get("SLU_CHAOS", "").strip()
             or DEFAULT_CHAOS_SPEC)
     seed = int(os.environ.get("SLU_CHAOS_SEED", "0") or "0")
@@ -310,6 +460,12 @@ def run_chaos(spec=None, argv=()):
             "batchers_replaced": m.counter("serve.batcher_replaced"),
             "breaker": (svc.cache.breaker.snapshot()
                         if svc.cache.breaker else None),
+            # traceability: every non-ok outcome must have a flight
+            # record naming its failing stage (the ISSUE-8 gate;
+            # pinned independently by tests/test_flight.py)
+            "exemplars": report.get("exemplars"),
+            "flight_traceability": _traceability(flight, report),
+            "slo": slo.snapshot(),
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", ""),
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -324,15 +480,22 @@ def run_chaos(spec=None, argv=()):
     # stamped-degraded: an untyped "error" outcome (a genuine bug
     # caught by the loadgen's last-resort handler) fails the gate too
     untyped = rec["by_status"].get("error", 0)
+    # every non-ok outcome is one lookup from a flight record naming
+    # its failing stage ("complete"); True when the recorder was
+    # explicitly disabled (SLU_FLIGHT=0) — the gate then only covers
+    # what it can see
+    traceable = rec["flight_traceability"].get("complete", True)
     rec["gate"] = {
         "zero_hangs": resolved_ok,
         "zero_nonfinite": nonfinite == 0,
         "all_typed": untyped == 0,
         "restart_warm": rec["restart"]["warm"],
         "corruption_contained": rec["corrupt_restart"]["contained"],
+        "traceable": traceable,
         "passed": (resolved_ok and nonfinite == 0 and untyped == 0
                    and rec["restart"]["warm"]
-                   and rec["corrupt_restart"]["contained"]),
+                   and rec["corrupt_restart"]["contained"]
+                   and traceable),
     }
     line = json.dumps(rec)
     print(line)
@@ -346,6 +509,21 @@ def run_chaos(spec=None, argv=()):
     return rec
 
 
+def _regress_gate(repo):
+    """Post-run perf-regression sentinel: the record just appended is
+    now the latest — gate it against the committed baselines."""
+    if os.environ.get("SLU_REGRESS", "1") == "0":
+        return
+    from tools import regress
+    findings, passed = regress.check_repo(repo)
+    print(regress.format_findings(findings), file=sys.stderr)
+    if not passed:
+        print("# PERF REGRESSION (tools/regress.py): see findings "
+              "above; a legitimate perf change re-baselines via "
+              "`python -m tools.regress --update`", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def main():
     argv = sys.argv[1:]
     if "--chaos" in argv:
@@ -353,6 +531,12 @@ def main():
         spec = (argv[i + 1] if i + 1 < len(argv)
                 and not argv[i + 1].startswith("--") else None)
         run_chaos(spec, argv)
+        return
+    if "--flight-ab" in argv:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        run_flight_ab(argv)
+        _regress_gate(repo)
         return
     rec = run(argv)
     # regression gate: batching must never LOSE to sequential and
@@ -386,6 +570,9 @@ def main():
               f"{mixed and mixed['recompiles_across_rungs']}",
               file=sys.stderr)
         raise SystemExit(1)
+    # historical gate: the fresh record vs the committed baselines
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _regress_gate(repo)
 
 
 if __name__ == "__main__":

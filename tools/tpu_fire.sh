@@ -254,4 +254,14 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
     rm -f "$ab_tmp"
   done
 fi
+
+# 10. Perf-regression sentinel (tools/regress.py): gate the round's
+#     freshly-landed records against the committed BASELINES.json —
+#     a window that measured a regression must say so in the log, not
+#     let the record land silently (runs in the dryrun too: the CPU
+#     records gate against the cpu baselines; absent-platform checks
+#     skip).  A legitimate perf change re-baselines via
+#     `python tools/regress.py --update` in the same commit.
+timeout 300 python "$repo/tools/regress.py" >> "$log" 2>&1
+stamp "regress rc=$?"
 stamp "fire done"

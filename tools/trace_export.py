@@ -12,6 +12,14 @@ converts those artifacts:
         convert a JSONL event log (SLU_TRACE_JSONL) into a
         Perfetto-loadable Chrome trace JSON
 
+    python -m tools.trace_export flight.jsonl -o flight.trace.json
+        convert a flight-recorder log (SLU_FLIGHT_JSONL,
+        obs/flight.py) into PER-REQUEST tracks: one pid per request
+        (process name "request <rid> [<outcome>]"), the request's
+        e2e span plus each stage event laid on its timeline — a
+        failed request's failing stage is visible at a glance.  The
+        format is auto-detected per line ("rid" + "events" keys).
+
 It is also the shared converter tools/tpu_profile.py uses to emit its
 fusion-class buckets as spans in the same trace format
 (`chrome_trace_from_profile`), so the profiled-step breakdown and the
@@ -50,11 +58,88 @@ def validate_events(events) -> None:
                     f"event {i} 'X' without a valid dur: {ev}")
 
 
+def is_flight_record(obj) -> bool:
+    """One SLU_FLIGHT_JSONL line: a per-request flight record
+    (obs/flight.py), not a raw trace event."""
+    return (isinstance(obj, dict) and "rid" in obj
+            and isinstance(obj.get("events"), list))
+
+
+def flight_to_chrome(records: list) -> list:
+    """Flight records -> per-request Chrome tracks: one pid per
+    request, named by rid and outcome; tid 0 carries the request's
+    e2e span, tid 1 the stage events (spans where the event carries
+    its own duration — queue wait, solve — instants otherwise).
+    Raises ValueError on a malformed record (same CLI hygiene as the
+    span-JSONL path)."""
+    events: list = []
+    for i, rec in enumerate(records):
+        if not is_flight_record(rec):
+            raise ValueError(f"record {i} is not a flight record: "
+                             f"{rec!r}")
+        rid = rec["rid"]
+        if not isinstance(rid, int):
+            raise ValueError(f"record {i} rid not an int: {rid!r}")
+        t0 = rec.get("t0_us", 0)
+        if not isinstance(t0, (int, float)):
+            raise ValueError(f"record {i} t0_us not numeric")
+        outcome = rec.get("outcome") or "?"
+        name = f"request {rid} [{outcome}]"
+        if rec.get("failed_stage"):
+            name += f" @{rec['failed_stage']}"
+        events.append({"name": "process_name", "ph": "M", "pid": rid,
+                       "tid": 0, "args": {"name": name}})
+        meta = dict(rec.get("meta") or {})
+        meta["error"] = rec.get("error")
+        events.append({"name": f"request.{outcome}", "cat": "flight",
+                       "ph": "X", "ts": t0,
+                       "dur": max(0, int(rec.get("e2e_us") or 0)),
+                       "pid": rid, "tid": 0, "args": meta})
+        for ev in rec["events"]:
+            if not isinstance(ev, dict) or "stage" not in ev:
+                raise ValueError(
+                    f"record {i} (rid {rid}) has a malformed "
+                    f"event: {ev!r}")
+            ts = t0 + int(ev.get("t_us", 0))
+            args = {k: v for k, v in ev.items()
+                    if k not in ("stage", "t_us")}
+            wait = ev.get("wait_us")
+            solve = ev.get("solve_us", ev.get("dur_us"))
+            if isinstance(wait, (int, float)) and wait >= 0 \
+                    and isinstance(solve, (int, float)) and solve >= 0:
+                # the combined batcher event stamps its END after the
+                # solve: [.. wait ..][.. solve ..]<ts
+                events.append({"name": "queue.wait", "cat": "flight",
+                               "ph": "X",
+                               "ts": ts - int(solve) - int(wait),
+                               "dur": int(wait), "pid": rid, "tid": 1,
+                               "args": args})
+                events.append({"name": "solve", "cat": "flight",
+                               "ph": "X", "ts": ts - int(solve),
+                               "dur": int(solve), "pid": rid,
+                               "tid": 1, "args": args})
+                continue
+            dur = solve if solve is not None else wait
+            if isinstance(dur, (int, float)) and dur >= 0:
+                # the event stamps its END; the span covers [ts-dur, ts]
+                events.append({"name": ev["stage"], "cat": "flight",
+                               "ph": "X", "ts": ts - int(dur),
+                               "dur": int(dur), "pid": rid, "tid": 1,
+                               "args": args})
+            else:
+                events.append({"name": ev["stage"], "cat": "flight",
+                               "ph": "i", "ts": ts, "pid": rid,
+                               "tid": 1, "s": "t", "args": args})
+    return events
+
+
 def load(path: str) -> list:
     """Events from a Chrome trace JSON ({"traceEvents": [...]} or a
-    bare array) or a JSONL event log.  Raises ValueError for content
-    that is not a trace (a validator that certifies corrupt or empty
-    artifacts as valid is worse than none)."""
+    bare array), a JSONL event log, or a flight-recorder JSONL
+    (auto-detected; converted to per-request tracks).  Raises
+    ValueError for content that is not a trace (a validator that
+    certifies corrupt or empty artifacts as valid is worse than
+    none)."""
     with open(path) as f:
         head = f.read(1)
         f.seek(0)
@@ -62,6 +147,10 @@ def load(path: str) -> list:
             events = [json.loads(line) for line in f if line.strip()]
             if not events:
                 raise ValueError(f"{path}: empty JSONL event log")
+            if any(is_flight_record(e) for e in events):
+                # all-or-nothing: a mixed log is corrupt, and
+                # flight_to_chrome raises on the stragglers
+                return flight_to_chrome(events)
             return events
         if head not in ("{", "["):
             raise ValueError(
