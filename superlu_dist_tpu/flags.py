@@ -25,6 +25,11 @@ FLAGS: dict[str, str] = {
     # --- extend-add lanes (ops/batched.py) ---
     "SLU_EA_BLOCK": "1/0 block-copy extend-add lane for contiguous child runs (default on)",
     "SLU_EA_BLOCK_MIN_RUN": "minimum contiguous run length routed to the block lane (default 8)",
+    # --- blocked trisolve (ops/trisolve.py, parallel/factor_dist.py) ---
+    "SLU_TRISOLVE": "auto|merged|legacy solve arm: merged = the communication-avoiding lsum trisolve (packed panels, dense lsum buffers, zero scatters; bitwise-identical to legacy, pinned); auto = merged on a single device and the legacy X-psum sweep on meshes; an EXPLICIT merged also routes mesh solves through the row-partitioned merged trisolve",
+    "SLU_TRISOLVE_MERGE_CELLS": "panel-cell bound (trim*mb*wb) under which a group joins a merged dispatch segment (default 65536); larger groups stand alone",
+    "SLU_TRISOLVE_SEG_CELLS": "total panel-cell budget of one merged segment (default 1048576) — bounds per-segment staged program size",
+    "SLU_TRISOLVE_PALLAS": "1 = fuse each merged forward group's panel-solve + lsum update into the Pallas lsum kernel (ops/pallas_lsum.py; f32/bf16 real only, default off until the fire-plan arm prices it)",
     # --- residual SpMV layout (ops/spmv.py) ---
     "SLU_SPMV_LAYOUT": "auto|ell|coo residual SpMV layout (ell = scatter-free padded rows)",
     "SLU_SPMV_ELL_WASTE": "max ELL padding ratio over true nnz before falling back to COO (default 4)",
@@ -95,7 +100,10 @@ FLAGS: dict[str, str] = {
     # --- tools/ drivers ---
     "SLU_SCALE_K": "tools/scale_run.py grid size (k=64 is the 262k certification)",
     "SLU_SCALE_OUT": "tools/scale_run.py output json path",
-    "SLU_SOLVE_K": "tools/solve_latency.py grid size (default 30)",
+    "SLU_SOLVE_K": "tools/solve_latency.py / bench.py --solve-sweep grid size (defaults 30 / 20)",
+    "SLU_SOLVE_MIN_SPEEDUP": "bench.py --solve-sweep gate: required merged-vs-legacy per-rhs speedup at nrhs=1 (default 2.0, the ISSUE-9 acceptance)",
+    "SLU_SOLVE_WORSE_TOL": "bench.py --solve-sweep gate: max merged/legacy wall ratio tolerated at nrhs=8/64 (default 1.10 — timeshared-box noise)",
+    "SLU_SOLVE_SWEEP_OUT": "bench.py --solve-sweep output path (default SOLVE_LATENCY.jsonl)",
     "SLU_PROFILE_K": "tools/tpu_profile.py grid size",
     "SLU_PROFILE_OUT": "tools/tpu_profile.py output json path",
     "SLU_PROFILE_DRYRUN": "1 = tpu_profile rehearsal on CPU (no tunnel required)",

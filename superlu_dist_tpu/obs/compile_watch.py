@@ -54,40 +54,73 @@ def _cost_enabled() -> bool:
     return os.environ.get("SLU_OBS_COST") == "1"
 
 
+def _leaf_sig(a):
+    """(shape, dtype) for an array-like, recursing into list/tuple
+    containers (the packed-trisolve solve fn takes a pytree of panel
+    arrays — repr() of a 200-array container would format every
+    array's CONTENTS, tens of ms per call), repr for static
+    scalars.
+
+    Attribute-capable containers (trisolve.PackSet, an immutable
+    tuple subclass) memoize their signature on themselves: rebuilding
+    a ~200-leaf signature measured 0.65 ms per call, ~18% of a
+    packed nrhs=1 solve.  Plain lists/tuples reject the setattr and
+    stay un-memoized (they may be mutated between calls)."""
+    shape = getattr(a, "shape", None)
+    if shape is not None and hasattr(a, "dtype"):
+        return (tuple(shape), str(a.dtype))
+    if isinstance(a, (list, tuple)):
+        memo = getattr(a, "_sig_cache", None)
+        if memo is not None:
+            return memo
+        sig = tuple(_leaf_sig(x) for x in a)
+        try:
+            a._sig_cache = sig
+        except (AttributeError, TypeError):
+            pass
+        return sig
+    return repr(a)
+
+
 def _sig_of(args, kwargs):
-    """Hashable jit-call signature: (shape, dtype) for array-likes,
-    repr for static scalars — the same partitioning jax's own cache
-    keys on for our call sites."""
-    parts = []
-    for a in args:
-        shape = getattr(a, "shape", None)
-        if shape is not None and hasattr(a, "dtype"):
-            parts.append((tuple(shape), str(a.dtype)))
-        else:
-            parts.append(repr(a))
+    """Hashable jit-call signature: (shape, dtype) for array-likes
+    (containers recursed), repr for static scalars — the same
+    partitioning jax's own cache keys on for our call sites."""
+    parts = [_leaf_sig(a) for a in args]
     for k in sorted(kwargs):
         v = kwargs[k]
         shape = getattr(v, "shape", None)
         if shape is not None and hasattr(v, "dtype"):
             parts.append((k, tuple(shape), str(v.dtype)))
         else:
-            parts.append((k, repr(v)))
+            # containers recurse like positional args (a keyword
+            # pytree must not fall into the repr-the-contents trap)
+            parts.append((k, _leaf_sig(v)))
     return tuple(parts)
 
 
 def _sig_attrib(sig) -> dict:
     """Human/trace-readable shapes+dtypes split of a signature."""
     shapes, dtypes, static = [], [], []
-    for p in sig:
+
+    def walk(p, key=None):
         if isinstance(p, tuple) and len(p) == 2 \
-                and isinstance(p[0], tuple):
+                and isinstance(p[0], tuple) and isinstance(p[1], str):
             shapes.append(list(p[0]))
             dtypes.append(p[1])
-        elif isinstance(p, tuple) and len(p) == 3:
+        elif (isinstance(p, tuple) and len(p) == 3
+              and isinstance(p[0], str)):
             shapes.append([p[0]] + list(p[1]))
             dtypes.append(p[2])
+        elif isinstance(p, tuple):
+            # container arg (the packed-panel pytree): flatten
+            for q in p:
+                walk(q)
         else:
             static.append(p if isinstance(p, str) else repr(p))
+
+    for p in sig:
+        walk(p)
     return {"shapes": shapes, "dtypes": dtypes, "static": static}
 
 

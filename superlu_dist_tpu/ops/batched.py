@@ -1549,10 +1549,15 @@ def _slice_panel(flat, off, size: int, shape: tuple):
     """dynamic_slice + reshape of one group's panel from a factor
     flat, handling both storages: a 1-D flat yields the panel array; a
     (2, N) stacked real/imag flat yields an (Ar, Ai) pair for
-    _mm_enc."""
+    _mm_enc.  `off` may be a traced jnp scalar (the in-program sweep)
+    or a host int (the eager trisolve pack) — the plane index matches
+    its dtype either way (dynamic_slice requires uniform index
+    dtypes)."""
     if flat.ndim == 2:
+        off = jnp.asarray(off)
         P = jax.lax.dynamic_slice(
-            flat, (jnp.int32(0), off), (2, size)).reshape((2,) + shape)
+            flat, (jnp.zeros((), off.dtype), off),
+            (2, size)).reshape((2,) + shape)
         return (P[0], P[1])
     return jax.lax.dynamic_slice(flat, (off,), (size,)).reshape(shape)
 
@@ -1775,12 +1780,26 @@ def _staged_factor_run(sched, vals, thresh_np, dtype,
 
 
 def _staged_sweeps(sched, panels, bf, dtype, trans: bool,
-                   pair: bool = False):
+                   pair: bool = False, packs=None):
     """Forward+backward sweeps over the staged panels.  `bf` is the
     RHS in factor ordering, shape (n, nrhs); returns X[:n].  In pair
     mode (plane-stored panels) `bf` arrives already real-view encoded
     (n, 2·nrhs) and the result returns encoded — the caller decodes on
-    the host, so the program stays complex-free."""
+    the host, so the program stays complex-free.
+
+    Under the merged trisolve arm (SLU_TRISOLVE, ops/trisolve.py)
+    the per-group dispatch chain collapses to one dispatch per merged
+    SEGMENT over the lsum layout — bitwise-identical results, a
+    fraction of the Python/dispatch overhead at small nrhs.  `packs`
+    lets a caller that solves repeatedly against one panel set (the
+    staged fused solver's refinement loop) pre-pack once."""
+    from . import trisolve
+    if trisolve.trisolve_mode() == "merged":
+        ts = trisolve.get_trisolve(sched)
+        if packs is None:
+            packs = trisolve.pack_panels_staged(ts, panels)
+        return trisolve.staged_sweeps(ts, packs, bf, dtype, trans,
+                                      pair=pair)
     dtype = np.dtype(dtype)
     n = sched.n
     if pair:
@@ -1890,7 +1909,14 @@ def _phase_fns(sched, dtype, thresh_np, pair=None):
     program once per racing thread."""
     if pair is None:
         pair = _pair_mode(dtype)
-    key = (np.dtype(dtype).str, float(thresh_np), pair)
+    from . import trisolve
+    # the trisolve arm shapes the solve program (_solve_loop routes
+    # through the merged lsum sweep), so it keys the cache — a
+    # mid-process SLU_TRISOLVE change builds fresh programs instead
+    # of hitting a stale arm
+    key = (np.dtype(dtype).str, float(thresh_np), pair,
+           trisolve.trisolve_mode(), trisolve.merge_cells_limit(),
+           trisolve.seg_cells_limit())
     # lock-free hit path: entries are inserted fully formed under the
     # lock, and dict reads are GIL-atomic — hot solve dispatches never
     # contend on the module lock
@@ -1984,10 +2010,23 @@ def _solve_device_common(lu, b: np.ndarray, trans: bool):
     pair = _lu_is_pair(lu)
     bin_ = (_pair_encode_rhs(bb.astype(xdt)) if pair
             else bb.astype(xdt))
+    from . import trisolve
+    merged = trisolve.trisolve_mode() == "merged"
     if isinstance(lu, StagedLU):
+        # merged: reuse the handle-cached packed panels so repeated
+        # FACTORED solves skip the per-solve re-slice
         X = _staged_sweeps(lu.schedule, lu.panels,
                            jnp.asarray(bin_), lu.dtype, trans,
-                           pair=pair)
+                           pair=pair,
+                           packs=(trisolve.get_packs(lu)
+                                  if merged else None))
+    elif merged:
+        # the packed FACTORED fast path (ops/trisolve.py): panels
+        # pre-sliced once per factorization, lsum layout instead of
+        # scatter-adds — the serve hot path's program.  Cost
+        # attribution happens inside solve_packed (same thread-local
+        # hand-off as below).
+        X = trisolve.solve_packed(lu, bin_, trans)
     else:
         _, solve_fn = _phase_fns(lu.schedule, lu.dtype,
                                  _thresh_for(lu.plan, lu.dtype),
@@ -2063,28 +2102,15 @@ def make_fused_step(plan: FactorPlan, dtype=np.float64):
                     jnp.int32(g.Li_off), jnp.int32(g.Ui_off),
                     mb=g.mb, wb=g.wb, n_pad=g.n_loc,
                     ea_meta=g.ea_meta, eb_meta=g.eb_meta)
-        # promote rather than cast: a complex rhs against a real
-        # factor must stay complex (matches solve_device)
-        xdt = jnp.promote_types(dtype, b.dtype)
-        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
-        X = jnp.zeros((sched.n + 1, b.shape[1]), xdt)
-        X = X.at[:sched.n, :].set(b.astype(xdt))
-        X = _enc(X, cplx)
-        for g in sched.groups:
-            col_idx, struct_idx = g.dev(squeeze=True)[5:7]
-            X = _fwd_group_impl(X, L_flat, Li_flat, col_idx,
-                                struct_idx, jnp.int32(g.L_off),
-                                jnp.int32(g.Li_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                cplx=cplx)
-        for g in reversed(sched.groups):
-            col_idx, struct_idx = g.dev(squeeze=True)[5:7]
-            X = _bwd_group_impl(X, U_flat, Ui_flat, col_idx,
-                                struct_idx, jnp.int32(g.U_off),
-                                jnp.int32(g.Ui_off),
-                                mb=g.mb, wb=g.wb, n_pad=g.n_loc,
-                                cplx=cplx)
-        return _dec(X, cplx)[:sched.n]
+        # the triangular sweeps ride the shared _solve_loop (which
+        # routes through the merged lsum trisolve when that arm is
+        # active), so this fused step and every other consumer cannot
+        # diverge; promote-not-cast rhs semantics live there too
+        from ..parallel.factor_dist import _solve_loop
+        pairs = [(g.dev(squeeze=True)[5], g.dev(squeeze=True)[6])
+                 for g in sched.groups]
+        return _solve_loop(sched, (L_flat, U_flat, Li_flat, Ui_flat),
+                           b, dtype, pairs, None, trans=False)
 
     return step
 
@@ -2499,16 +2525,24 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
         _axpy = jax.jit(lambda x, d: x + d)
 
         def step(vals, b):
+            from . import trisolve
             vals = jnp.asarray(vals)
             panels, tiny, nzero = _staged_factor_run(
                 sched, _scale(vals), thresh_np, dtype, pair=pair)
             vals_r = vals.astype(rrdt if pair else rdt)
             abs_vals = _abs_impl(vals_r)
             b = jnp.asarray(b).astype(rrdt if pair else rdt)
+            # pack the solve panels once per factorization so the
+            # refinement loop's repeated sweeps skip the re-slice
+            packs = (trisolve.pack_panels_staged(
+                         trisolve.get_trisolve(sched), panels)
+                     if trisolve.trisolve_mode() == "merged"
+                     else None)
 
             def solve_once(r):
                 y = _staged_sweeps(sched, panels, _pre(r), dtype,
-                                   trans=False, pair=pair)
+                                   trans=False, pair=pair,
+                                   packs=packs)
                 return _post(y)
 
             t32 = jnp.asarray(tiny, jnp.int32)

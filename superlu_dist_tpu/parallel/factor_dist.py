@@ -51,9 +51,11 @@ from .. import obs
 from ..plan.plan import FactorPlan
 from ..utils.compat import shard_map as _shard_map
 from ..ops.batched import (_bwd_group_impl, _bwd_group_T_impl, _dec,
-                           _enc, _factor_group_impl, _fwd_group_impl,
+                           _enc, _factor_group_impl,
+                           _flat_axis_index, _fwd_group_impl,
                            _fwd_group_T_impl, _hi_prec, _real_dtype,
-                           _solve_view, _thresh_for, get_schedule)
+                           _solve_view, _thresh_for, get_schedule,
+                           psum_exact)
 
 
 def _resolve_axis(mesh: Mesh, axis):
@@ -143,6 +145,24 @@ def _solve_loop(dsched, flats, b, dtype, per_group, axis,
     # at factor time in DistLU.
     L_flat, U_flat, Li_flat, Ui_flat = (
         _solve_view(f) for f in flats)
+
+    # merged trisolve arm (ops/trisolve.py, SLU_TRISOLVE): the
+    # single-device sweep re-expressed over the lsum gather/update
+    # layout — packed panels, dense update buffers, no scatters,
+    # bitwise-identical results.  The packing slices here are
+    # loop-invariant inside the fused solvers' refinement while_loop,
+    # so XLA hoists them and the repeated sweeps pay only the lsum
+    # dataflow.  Mesh execution (axis mode) keeps the X psum sweep in
+    # THIS loop; the row-partitioned merged mesh trisolve lives in
+    # make_dist_solve (solve_merged_mesh).
+    if axis is None:
+        from ..ops import trisolve
+        if trisolve.trisolve_mode() == "merged":
+            ts = trisolve.get_trisolve(dsched)
+            packs = trisolve.pack_panels(
+                ts, (L_flat, U_flat, Li_flat, Ui_flat))
+            return trisolve.sweep(ts, packs, b, dtype, trans,
+                                  pair=pair)
     n = dsched.n
     if pair:
         # pair-stored factors: flats are already (2, N) planes and b
@@ -412,6 +432,171 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     return factor
 
 
+def make_dist_solve_merged(plan: FactorPlan, mesh: Mesh,
+                           dtype=np.float64, axis=None,
+                           trans: bool = False):
+    """Row-partitioned merged mesh trisolve (SLU_TRISOLVE=merged on a
+    mesh): one solve spans devices over the lsum layout
+    (ops/trisolve.py).  Each device sweeps its own front partition —
+    the rows its fronts own — writing y/update blocks DENSELY into
+    its device-major slices of the global Y/UPD/XF slot spaces, and
+    the cross-device dataflow is a psum-of-diffs reconciliation of
+    those dense buffers at the merged segments' static sync points:
+    the reference's C_Tree lsum reduction (SRC/pdgstrs.c:2133)
+    collapsed to one all-reduce per segment boundary instead of one
+    per supernode.  Interior segments (zone-affine subtrees) sweep
+    with ZERO collectives.
+
+    Bit-matching contract: every dense slot is written exactly once
+    by exactly one device and reconciled as v = 0 + (v - 0) + 0·…, so
+    the mesh execution is bitwise the sequential execution of the
+    same layout on one device (`mesh_oracle_solve` pins it)."""
+    axis, ndev = _resolve_axis(mesh, axis)
+    dsched = get_schedule(plan, ndev)
+    from ..ops import trisolve as tsv
+    ts = tsv.get_trisolve(dsched)
+    dtype = np.dtype(dtype)
+    n = dsched.n
+
+    idx_args = tuple(a for gs in ts.groups
+                     for a in gs.dev(squeeze=False))
+    idx_specs = tuple(P(axis) for _ in idx_args)
+
+    def body(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_flat):
+        flats = tuple(_solve_view(f)
+                      for f in (L_flat, U_flat, Li_flat, Ui_flat))
+        packs = tsv.pack_panels(ts, flats)
+        it = iter(idx_flat)
+        per_group = [tuple(next(it)[0] for _ in range(3))
+                     for _ in ts.groups]
+        di = _flat_axis_index(axis)
+        xdt = jnp.promote_types(dtype, b.dtype)
+        cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+        B0 = _enc(b.astype(xdt), cplx)
+        R = B0.shape[-1]
+        rdt = B0.dtype
+        B, UPD, Y = tsv.init_lsum_buffers(ts, B0)
+        UPDs = UPD
+
+        def dev_meta(i):
+            g = dsched.groups[i]
+            gs = ts.groups[i]
+            return g, tsv._Meta(
+                trim=gs.trim, rtrim=gs.rtrim, J=gs.J,
+                y_off=gs.y_off + di * gs.trim * g.wb,
+                u_off=gs.u_off + di * gs.trim * gs.rtrim)
+
+        def sync(cur, snap):
+            new = snap + psum_exact(cur - snap, axis)
+            return new, new
+
+        state = (B, UPD, Y)
+        for seg, need in zip(ts.segments, ts.seg_fwd_sync):
+            if need:
+                B_, UPD_, Y_ = state
+                UPD_, UPDs = sync(UPD_, UPDs)
+                state = (B_, UPD_, Y_)
+            for i in seg:
+                g, gsd = dev_meta(i)
+                state = tsv._fwd_member(state, g, gsd, packs[i],
+                                        per_group[i], cplx, trans)
+        _, _, Y = state
+        XF = jnp.zeros((ts.y_total + 1, R), rdt)
+        XFs = XF
+        for seg, need in zip(reversed(ts.segments),
+                             list(reversed(ts.seg_bwd_sync))):
+            if need:
+                XF, XFs = sync(XF, XFs)
+            for i in reversed(seg):
+                g, gsd = dev_meta(i)
+                XF = tsv._bwd_member(XF, Y, g, gsd, packs[i],
+                                     per_group[i], cplx, trans)
+        XF, _ = sync(XF, XFs)     # replicate the final solution
+        x = XF[jnp.asarray(ts.final_idx)]
+        return _dec(x, cplx)
+
+    mapped = _shard_map(
+        _hi_prec(body), mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P())
+        + idx_specs,
+        out_specs=P(), check_vma=False)
+
+    @jax.jit
+    def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
+        return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
+
+    return obs.watch_jit("dist_solve_merged", solve,
+                         cost_phase="SOLVE")
+
+
+def mesh_oracle_solve(dlu: DistLU, b_factor_order,
+                      trans: bool = False):
+    """Sequential one-device execution of a DistLU's merged mesh
+    layout: per group, each device's member step runs in device order
+    with EXACTLY the per-device operand shapes the shard_map'd solve
+    uses (XLA:CPU lowers a batch-2t GEMV differently from two
+    batch-t GEMVs, so shape identity is required for bit identity).
+    Every dense slot is written once by one device, and consumers
+    gather cross-device slots only after the mesh's sync points would
+    have replicated them (0 + (v - 0) + 0 + ... = v bit-exact), so
+    this sequential execution IS the mesh execution — the bit-match
+    oracle, no collectives, no shard_map."""
+    from ..ops import trisolve as tsv
+    from ..ops.batched import _dec, _enc
+    dsched = dlu.schedule
+    ndev = dsched.ndev
+    ts = tsv.get_trisolve(dsched)
+    flats = [np.asarray(f) for f in (dlu.L_flat, dlu.U_flat,
+                                     dlu.Li_flat, dlu.Ui_flat)]
+
+    def dev_pack(g, gs, d):
+        def cut(flat, off, shape):
+            per = shape[0] * shape[1]
+            v = flat.reshape(ndev, -1)[d, off:off + gs.trim * per]
+            return v.reshape((gs.trim,) + shape)
+
+        Lp = cut(flats[0], g.L_off, (g.mb, g.wb))
+        Up = cut(flats[1], g.U_off, (g.wb, g.mb))
+        Lip = cut(flats[2], g.Li_off, (g.wb, g.wb))
+        Uip = cut(flats[3], g.Ui_off, (g.wb, g.wb))
+        return (jnp.asarray(Lip), jnp.asarray(Lp[:, g.wb:, :]),
+                jnp.asarray(Uip), jnp.asarray(Up[:, :, g.wb:]))
+
+    def dev_meta(g, gs, d):
+        return tsv._Meta(trim=gs.trim, rtrim=gs.rtrim, J=gs.J,
+                         y_off=gs.y_off + d * gs.trim * g.wb,
+                         u_off=gs.u_off + d * gs.trim * gs.rtrim)
+
+    def dev_idx(gs, d):
+        return (jnp.asarray(gs.b_idx[d]),
+                jnp.asarray(gs.u_gidx[d]),
+                jnp.asarray(gs.xs_idx[d]))
+
+    b = jnp.asarray(b_factor_order)
+    xdt = jnp.promote_types(dlu.dtype, b.dtype)
+    cplx = bool(jnp.issubdtype(xdt, jnp.complexfloating))
+    B0 = _enc(b.astype(xdt), cplx)
+    R = B0.shape[-1]
+    rdt = B0.dtype
+    state = tsv.init_lsum_buffers(ts, B0)
+    with jax.default_matmul_precision("float32"):
+        for g, gs in zip(dsched.groups, ts.groups):
+            for d in range(ndev):
+                state = tsv._fwd_member(
+                    state, g, dev_meta(g, gs, d), dev_pack(g, gs, d),
+                    dev_idx(gs, d), cplx, trans)
+        _, _, Y = state
+        XF = jnp.zeros((ts.y_total + 1, R), rdt)
+        for g, gs in zip(reversed(dsched.groups),
+                         list(reversed(ts.groups))):
+            for d in range(ndev):
+                XF = tsv._bwd_member(
+                    XF, Y, g, dev_meta(g, gs, d), dev_pack(g, gs, d),
+                    dev_idx(gs, d), cplx, trans)
+    x = XF[jnp.asarray(ts.final_idx)]
+    return np.asarray(_dec(x, cplx))
+
+
 def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                     axis=None, trans: bool = False):
     """Build `solve(L, U, Li, Ui, b) -> x` against persistent sharded
@@ -565,11 +750,15 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
         scache = plan._dist_solve_fns = {}
     _, ndev = _resolve_axis(dlu.mesh, dlu.axis)
     # measure the solve program dist_solve actually runs at this nrhs
+    from ..ops import trisolve as tsv
     sharded_rhs = _rhs_sharded_auto(nrhs, ndev)
-    skey = (dlu.mesh, dlu.dtype.str, dlu.axis, False, sharded_rhs)
+    merged = tsv.mesh_merged_on() and not sharded_rhs
+    skey = (dlu.mesh, dlu.dtype.str, dlu.axis, False, sharded_rhs,
+            merged)
     if skey not in scache:
         mk = (make_dist_solve_rhs_sharded if sharded_rhs
-              else make_dist_solve)
+              else (make_dist_solve_merged if merged
+                    else make_dist_solve))
         scache[skey] = mk(plan, dlu.mesh, dtype=dlu.dtype,
                           axis=dlu.axis, trans=False)
     solve = scache[skey]
@@ -624,10 +813,17 @@ def dist_solve(dlu: DistLU, b_factor_order, trans: bool = False):
         if getattr(b_factor_order, "ndim", 1) == 2 else 1
     _, ndev = _resolve_axis(dlu.mesh, dlu.axis)
     sharded_rhs = _rhs_sharded_auto(nrhs, ndev)
-    key = (dlu.mesh, dlu.dtype.str, dlu.axis, trans, sharded_rhs)
+    from ..ops import trisolve as tsv
+    # explicit SLU_TRISOLVE=merged: the row-partitioned merged mesh
+    # trisolve replaces the replicated-X psum sweep (narrow-RHS lane
+    # only — wide RHS keeps the gather-amortized rhs-sharded sweep)
+    merged = tsv.mesh_merged_on() and not sharded_rhs
+    key = (dlu.mesh, dlu.dtype.str, dlu.axis, trans, sharded_rhs,
+           merged)
     if key not in cache:
         mk = (make_dist_solve_rhs_sharded if sharded_rhs
-              else make_dist_solve)
+              else (make_dist_solve_merged if merged
+                    else make_dist_solve))
         cache[key] = mk(plan, dlu.mesh, dtype=dlu.dtype,
                         axis=dlu.axis, trans=trans)
     return cache[key](dlu.L_flat, dlu.U_flat, dlu.Li_flat,

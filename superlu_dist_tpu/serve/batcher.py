@@ -47,6 +47,15 @@ BUCKET_LADDER = (1, 8, 16, 32, 64)
 _DEADLINE_FLUSH_MARGIN_S = 0.001
 
 
+def _trisolve_arm(lu) -> str:
+    """The solve arm serving this dispatch (ops/trisolve.active_arm,
+    resolved against the handle so a staged or non-Pallas-capable
+    factorization is never labeled '+pallas'); import deferred so the
+    batcher never pays an ops import on the module path."""
+    from ..ops.trisolve import active_arm
+    return active_arm(getattr(lu, "device_lu", None))
+
+
 def bucket_for(nrhs: int, ladder=BUCKET_LADDER) -> int:
     """Smallest ladder bucket ≥ nrhs (callers cap nrhs at ladder[-1])."""
     for b in ladder:
@@ -341,12 +350,17 @@ class MicroBatcher:
         done = time.monotonic()
         solve_us = int(solve_s * 1e6)
         occ = round(len(live) / k, 4) if bid is not None else 0.0
+        # which trisolve arm served this batch (resolved per dispatch
+        # — a mid-run SLU_TRISOLVE flip must not mislabel exemplars):
+        # p99 latency attribution in obs/flight.py needs to know
+        # whether the merged lsum kernel or the legacy sweep ran
+        arm = _trisolve_arm(self.lu) if bid is not None else None
         for j, r in enumerate(live):
             if r.flight is not None:
                 r.flight.event(
                     "queue", wait_us=int((now - r.t_submit) * 1e6),
                     batch=bid, bucket=k, occupancy=occ,
-                    solve_us=solve_us)
+                    solve_us=solve_us, arm=arm)
             if r.deadline is not None and done > r.deadline:
                 # the work is done, but a missed deadline must never
                 # read as success — the caller already moved on

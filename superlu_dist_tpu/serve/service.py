@@ -917,10 +917,14 @@ def solve_jit_cache_size(lu: LUFactorization) -> int:
     (host backend, staged per-group execution)."""
     if lu.backend != "jax" or lu.device_lu is None:
         return -1
-    from ..ops import batched
+    from ..ops import batched, trisolve
     d = lu.device_lu
     if isinstance(d, batched.StagedLU):
         return -1
+    if trisolve.trisolve_mode() == "merged":
+        # the merged arm dispatches the packed solve program
+        # (trisolve.solve_packed), not _phase_fns' — probe that one
+        return trisolve.solve_packed_cache_size(d)
     _, solve_fn = batched._phase_fns(
         d.schedule, d.dtype, batched._thresh_for(lu.plan, d.dtype),
         pair=batched._lu_is_pair(d))

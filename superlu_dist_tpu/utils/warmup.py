@@ -79,6 +79,18 @@ def staged_signatures(sched):
         fsigs.setdefault(fkey, g)
         skey = (g.mb, g.wb, g.n_loc, aval(ci), aval(si))
         ssigs.setdefault(skey, g)
+    from ..ops import trisolve as T
+    if T.trisolve_mode() == "merged":
+        # the merged arm dispatches one program per SEGMENT
+        # (trisolve.staged_sweeps), keyed by the member meta tuple —
+        # warm THOSE, not the legacy per-group sweep programs
+        ts = T.get_trisolve(sched)
+        ssigs = {}
+        for seg_i, seg in enumerate(ts.segments):
+            # the shared static-key definition (trisolve.seg_metas):
+            # cplx is uniform across a warmup pass, so False is a
+            # valid dedup key here
+            ssigs.setdefault(T.seg_metas(ts, seg, False), seg_i)
     return fsigs, ssigs
 
 
@@ -167,11 +179,60 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
                     kind=kind)
             lowered.compile()
 
+    # merged-arm sweep warmup: one fwd + one bwd program per merged
+    # SEGMENT (trisolve.staged_sweeps), operands mirrored exactly —
+    # packs avals from the schedule extents, index avals from the
+    # GroupSolve layout, metas/member order identical to the dispatch
+    # site (bwd runs members reversed)
+    from ..ops import trisolve as T
+    merged = T.trisolve_mode() == "merged"
+    ts = T.get_trisolve(sched) if merged else None
+
+    def compile_seg(item):
+        _key, seg_i = item
+        seg = ts.segments[seg_i]
+
+        def operands(i):
+            g = sched.groups[i]
+            gs = ts.groups[i]
+            rb = g.mb - g.wb
+            pack = (
+                jax.ShapeDtypeStruct((gs.trim, g.wb, g.wb), dtype),
+                jax.ShapeDtypeStruct((gs.trim, rb, g.wb), dtype),
+                jax.ShapeDtypeStruct((gs.trim, g.wb, g.wb), dtype),
+                jax.ShapeDtypeStruct((gs.trim, g.wb, rb), dtype),
+            )
+            idx = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in gs.dev(squeeze=True))
+            return pack, idx
+
+        fwd = [operands(i) for i in seg]
+        bwd = [operands(i) for i in reversed(seg)]
+        Ba = jax.ShapeDtypeStruct((sched.n + 1, r_hat), xdt)
+        Ua = jax.ShapeDtypeStruct((ts.u_total + 1, r_hat), xdt)
+        Ya = jax.ShapeDtypeStruct((ts.y_total + 1, r_hat), xdt)
+        with _LOWER_LOCK:
+            lf = T._staged_fwd_segment.lower(
+                Ba, Ua, Ya, tuple(p for p, _ in fwd),
+                tuple(ix for _, ix in fwd),
+                metas=T.seg_metas(ts, seg, x_cplx), trans=trans)
+        lf.compile()
+        with _LOWER_LOCK:
+            lb = T._staged_bwd_segment.lower(
+                Ya, Ya, tuple(p for p, _ in bwd),
+                tuple(ix for _, ix in bwd),
+                metas=T.seg_metas(ts, list(reversed(seg)), x_cplx),
+                trans=trans)
+        lb.compile()
+
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=workers) as ex:
         list(ex.map(compile_factor, fsigs.items()))
-        list(ex.map(compile_sweep, ssigs.items()))
+        if merged:
+            list(ex.map(compile_seg, ssigs.items()))
+        else:
+            list(ex.map(compile_sweep, ssigs.items()))
     return {"factor_programs": len(fsigs),
-            "sweep_programs": len(ssigs) * len(kinds),
+            "sweep_programs": len(ssigs) * 2,
             "workers": workers,
             "secs": round(time.perf_counter() - t0, 2)}

@@ -86,6 +86,9 @@ def test_record_carries_every_stage():
     q = next(e for e in rec["events"] if e["stage"] == "queue")
     assert {"wait_us", "batch", "bucket", "occupancy",
             "solve_us"} <= set(q)
+    # the dispatch records which trisolve arm served the batch, so
+    # p99 exemplars attribute latency to the right kernel (ISSUE 9)
+    assert q.get("arm") in ("merged", "legacy", "merged+pallas")
     assert rec["e2e_us"] > 0
     # exported through the unified registry
     assert obs.snapshot()["flight"]["records"]

@@ -1,0 +1,349 @@
+"""Merged (lsum) trisolve vs the legacy level sweep.
+
+The ISSUE-9 correctness contract: the communication-avoiding blocked
+trisolve (ops/trisolve.py) performs EXACTLY the legacy sweep's
+arithmetic — packed panels, dense lsum buffers and contributor-gather
+chains are data movement, and the contributor chain replays the
+legacy scatter-add application order — so its results are pinned
+BITWISE (np.array_equal) against the legacy arm at fp64 on CPU,
+across the forward, transpose, staged, fused, pair-storage and
+2-device mesh paths."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from superlu_dist_tpu import Options, factorize, solve
+from superlu_dist_tpu.options import Trans
+from superlu_dist_tpu.ops import batched, trisolve
+from superlu_dist_tpu.plan.plan import plan_factorization
+from superlu_dist_tpu.utils.testmat import (helmholtz_2d,
+                                            laplacian_3d,
+                                            manufactured_rhs,
+                                            random_unsymmetric)
+
+
+def _mats():
+    return [laplacian_3d(8),
+            random_unsymmetric(300, density=0.03, seed=5)]
+
+
+def _solve_both(monkeypatch, d, b, trans):
+    monkeypatch.setenv("SLU_TRISOLVE", "legacy")
+    fn = (batched.solve_device_trans if trans
+          else batched.solve_device)
+    x_leg = fn(d, b)
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    x_mrg = fn(d, b)
+    return x_leg, x_mrg
+
+
+@pytest.mark.parametrize("trans", [False, True])
+@pytest.mark.parametrize("mi", [0, 1])
+def test_merged_bitwise_parity_f64(monkeypatch, mi, trans):
+    """solve_device / solve_device_trans: merged == legacy bitwise at
+    fp64, nrhs 1 and 3 (the serving FACTORED rung)."""
+    a = _mats()[mi]
+    lu = factorize(a, Options(), backend="jax")
+    rng = np.random.default_rng(0)
+    for nrhs in (1, 3):
+        b = rng.standard_normal((a.n, nrhs))
+        x_leg, x_mrg = _solve_both(monkeypatch, lu.device_lu, b,
+                                   trans)
+        assert np.array_equal(x_leg, x_mrg), (
+            f"trans={trans} nrhs={nrhs}: merged diverged, "
+            f"maxdiff={np.abs(x_leg - x_mrg).max()}")
+
+
+def test_merged_full_driver_accuracy(monkeypatch):
+    """End-to-end gssvx (refinement included) through the merged arm
+    solves to the oracle."""
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    from superlu_dist_tpu import gssvx
+    a = laplacian_3d(8)
+    xtrue, b = manufactured_rhs(a)
+    x, _, st = gssvx(Options(), a, b, backend="jax")
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8)
+    xt, _, _ = gssvx(Options(trans=Trans.TRANS), a,
+                     a.to_scipy().T @ xtrue, backend="jax")
+    np.testing.assert_allclose(xt, xtrue, rtol=1e-8)
+
+
+def test_merged_staged_parity(monkeypatch):
+    """Staged execution (per-segment dispatch) matches the legacy
+    staged sweep bitwise at fp64."""
+    monkeypatch.setenv("SLU_STAGED", "1")
+    a = laplacian_3d(8)
+    lu = factorize(a, Options(), backend="jax")
+    d = lu.device_lu
+    assert isinstance(d, batched.StagedLU)
+    rng = np.random.default_rng(1)
+    for trans in (False, True):
+        b = rng.standard_normal((a.n, 2))
+        x_leg, x_mrg = _solve_both(monkeypatch, d, b, trans)
+        assert np.array_equal(x_leg, x_mrg)
+    # the merged staged path dispatches one program per SEGMENT —
+    # strictly fewer host dispatches than the per-group chain
+    ts = trisolve.get_trisolve(d.schedule)
+    assert len(ts.segments) <= len(d.schedule.groups)
+
+
+def test_merged_fused_step_parity(monkeypatch):
+    """make_fused_step builds bitwise-identical outputs under both
+    arms (its sweep rides the shared _solve_loop)."""
+    a = laplacian_3d(8)
+    xtrue, b = manufactured_rhs(a)
+    plan = plan_factorization(a, Options())
+    bf = np.empty_like(b)
+    bf[plan.final_row] = b * plan.row_scale
+    vals = jnp.asarray(plan.scaled_values(a))
+    outs = {}
+    for arm in ("legacy", "merged"):
+        monkeypatch.setenv("SLU_TRISOLVE", arm)
+        step = batched.make_fused_step(plan)
+        outs[arm] = np.asarray(step(vals, jnp.asarray(bf[:, None])))
+    assert np.array_equal(outs["legacy"], outs["merged"])
+    xs = outs["merged"][plan.final_col][:, 0] * plan.col_scale
+    np.testing.assert_allclose(xs, xtrue, rtol=1e-8, atol=1e-8)
+
+
+def test_merged_fused_solver(monkeypatch):
+    """The fused whole-driver solver (refinement while_loop) through
+    the merged sweep converges to the oracle at f32+IR."""
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    a = laplacian_3d(8)
+    xtrue, b = manufactured_rhs(a)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    step = batched.make_fused_solver(plan, dtype="float32")
+    x, berr, steps, tiny, nzero = step(jnp.asarray(a.data),
+                                       jnp.asarray(b[:, None]))
+    relerr = (np.linalg.norm(np.asarray(x)[:, 0] - xtrue)
+              / np.linalg.norm(xtrue))
+    assert relerr < 1e-9
+
+
+def test_merged_complex_native_parity(monkeypatch):
+    """Native complex storage (real-view sweep codec): merged ==
+    legacy bitwise at c128."""
+    a = helmholtz_2d(6)
+    lu = factorize(a, Options(factor_dtype="complex128"),
+                   backend="jax")
+    rng = np.random.default_rng(2)
+    b = (rng.standard_normal((a.n, 2))
+         + 1j * rng.standard_normal((a.n, 2)))
+    for trans in (False, True):
+        x_leg, x_mrg = _solve_both(monkeypatch, lu.device_lu, b,
+                                   trans)
+        assert np.array_equal(x_leg, x_mrg)
+
+
+def test_merged_pair_storage_parity(monkeypatch):
+    """Pair-plane complex storage (SLU_COMPLEX_PAIR=1): the merged
+    sweep consumes (Ar, Ai) packed panels and stays bitwise with the
+    legacy pair sweep — and its packed program stays complex-free."""
+    monkeypatch.setenv("SLU_COMPLEX_PAIR", "1")
+    a = helmholtz_2d(6)
+    lu = factorize(a, Options(factor_dtype="complex128"),
+                   backend="jax")
+    d = lu.device_lu
+    assert batched._lu_is_pair(d)
+    rng = np.random.default_rng(3)
+    b = (rng.standard_normal((a.n, 2))
+         + 1j * rng.standard_normal((a.n, 2)))
+    for trans in (False, True):
+        x_leg, x_mrg = _solve_both(monkeypatch, d, b, trans)
+        assert np.array_equal(x_leg, x_mrg)
+    # complex-free pin on the packed merged program (the pair lane's
+    # certification property, test_pair precedent)
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    fn = trisolve._solve_packed_fn(d.schedule, d.dtype, True)[0]
+    packs = trisolve.get_packs(d)
+    benc = batched._pair_encode_rhs(b.astype(np.complex128))
+    txt = fn.lower(packs, jnp.asarray(benc)).as_text()
+    assert "c128" not in txt and "c64" not in txt
+
+
+def test_packed_program_scatter_free(monkeypatch):
+    """The headline structural property: the merged packed solve
+    program contains NO scatter ops at all (the legacy sweep's
+    scatter-adds were the slowest op class at nrhs=1)."""
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    a = laplacian_3d(8)
+    lu = factorize(a, Options(factor_dtype="float32"),
+                   backend="jax")
+    d = lu.device_lu
+    fn = trisolve._solve_packed_fn(d.schedule, d.dtype, False)[0]
+    packs = trisolve.get_packs(d)
+    b = jnp.zeros((a.n, 1), jnp.float32)
+    txt = fn.lower(packs, b).as_text()
+    assert "scatter" not in txt.lower()
+
+
+def test_packed_zero_recompiles(monkeypatch):
+    """Repeated solves at one nrhs bucket never grow the packed solve
+    program's jit cache (the serve zero-recompile contract's probe,
+    serve.solve_jit_cache_size)."""
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    from superlu_dist_tpu.serve import solve_jit_cache_size
+    a = laplacian_3d(6)
+    lu = factorize(a, Options(factor_dtype="float32"),
+                   backend="jax")
+    rng = np.random.default_rng(4)
+    b = rng.standard_normal((a.n, 8)).astype(np.float32)
+    solve(lu, b)
+    before = solve_jit_cache_size(lu)
+    assert before >= 1
+    for _ in range(3):
+        solve(lu, b)
+    assert solve_jit_cache_size(lu) == before
+
+
+def test_trisolve_schedule_structure():
+    """Structural invariants of the lsum layout: segments partition
+    the groups in order; every row owns exactly one XF slot; the
+    contributor table is consistent with the struct writes."""
+    a = laplacian_3d(8)
+    plan = plan_factorization(a, Options())
+    sched = batched.get_schedule(plan, 1)
+    ts = trisolve.get_trisolve(sched)
+    flat = [i for seg in ts.segments for i in seg]
+    assert flat == list(range(len(sched.groups)))
+    assert len(ts.final_idx) == a.n
+    assert len(np.unique(ts.final_idx)) == a.n      # slots injective
+    assert ts.final_idx.max() < ts.y_total
+    # total contributor references == total live struct writes
+    writes = sum(int((np.asarray(g.struct_idx)[:, :gs.trim, :]
+                      < a.n).sum())
+                 for g, gs in zip(sched.groups, ts.groups))
+    refs = sum(int((np.asarray(gs.u_gidx) < ts.u_total).sum())
+               for gs in ts.groups)
+    assert refs == writes
+
+
+def test_merge_cells_flag_segments(monkeypatch):
+    """SLU_TRISOLVE_MERGE_CELLS=0 disables merging (every group its
+    own segment); a huge limit merges the chain tail."""
+    a = laplacian_3d(8)
+    plan = plan_factorization(a, Options())
+    sched = batched.get_schedule(plan, 1)
+    monkeypatch.setenv("SLU_TRISOLVE_MERGE_CELLS", "0")
+    ts0 = trisolve.get_trisolve(sched)
+    assert len(ts0.segments) == len(sched.groups)
+    monkeypatch.setenv("SLU_TRISOLVE_MERGE_CELLS", str(1 << 30))
+    monkeypatch.setenv("SLU_TRISOLVE_SEG_CELLS", str(1 << 40))
+    ts1 = trisolve.get_trisolve(sched)
+    assert len(ts1.segments) < len(sched.groups)
+
+
+def test_mesh_merged_bitmatch_oracle(monkeypatch):
+    """2-device row-partitioned merged trisolve: the shard_map'd
+    solve bit-matches the sequential one-device execution of the SAME
+    lsum layout (every dense slot is written once by one device and
+    reconciled as 0 + (v - 0) + 0·…), and stays allclose to the
+    legacy mesh sweep."""
+    from jax.sharding import Mesh
+    from superlu_dist_tpu.parallel import factor_dist
+    devs = np.array(jax.devices()[:2])
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = Mesh(devs.reshape(2), ("d",))
+    a = laplacian_3d(8)
+    plan = plan_factorization(a, Options())
+    factor = factor_dist.make_dist_factor(plan, mesh)
+    dlu = factor(plan.scaled_values(a))
+    rng = np.random.default_rng(5)
+    b = rng.standard_normal((a.n, 1))
+    solve_m = factor_dist.make_dist_solve_merged(plan, mesh)
+    x_mesh = np.asarray(solve_m(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                                dlu.Ui_flat, jnp.asarray(b)))
+    x_oracle = factor_dist.mesh_oracle_solve(dlu, b)
+    assert np.array_equal(x_mesh, x_oracle), (
+        f"maxdiff={np.abs(x_mesh - x_oracle).max()}")
+    solve_l = factor_dist.make_dist_solve(plan, mesh)
+    x_leg = np.asarray(solve_l(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
+                               dlu.Ui_flat, jnp.asarray(b)))
+    np.testing.assert_allclose(x_mesh, x_leg, rtol=1e-12, atol=1e-12)
+
+
+def test_mesh_merged_dist_solve_routing(monkeypatch):
+    """dist_solve routes through the merged mesh trisolve only under
+    an EXPLICIT SLU_TRISOLVE=merged (auto keeps the proven X-psum
+    sweep on meshes)."""
+    monkeypatch.delenv("SLU_TRISOLVE", raising=False)
+    assert not trisolve.mesh_merged_on()
+    assert trisolve.trisolve_mode() == "merged"
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    assert trisolve.mesh_merged_on()
+    monkeypatch.setenv("SLU_TRISOLVE", "legacy")
+    assert trisolve.trisolve_mode() == "legacy"
+    assert not trisolve.mesh_merged_on()
+
+
+def test_pallas_lsum_oracle():
+    """The fused Pallas lsum kernel (interpret mode on CPU) matches
+    the einsum pair it replaces."""
+    from superlu_dist_tpu.ops import pallas_lsum
+    if not pallas_lsum._HAVE_PALLAS:
+        pytest.skip("pallas unavailable")
+    rng = np.random.default_rng(6)
+    t, wb, rb, R = 5, 16, 40, 3
+    Li = rng.standard_normal((t, wb, wb)).astype(np.float32)
+    L21 = rng.standard_normal((t, rb, wb)).astype(np.float32)
+    xb = rng.standard_normal((t, wb, R)).astype(np.float32)
+    try:
+        y, upd = pallas_lsum.lsum_panel(
+            jnp.asarray(Li), jnp.asarray(L21), jnp.asarray(xb),
+            interpret=True)
+    except Exception as e:   # noqa: BLE001 — environment lowering bug
+        msg = str(e)
+        if "func.call" in msg and "operand type mismatch" in msg:
+            pytest.skip("jax/Mosaic lowering bug in this "
+                        "environment: func.call i64/i32 operand "
+                        "mismatch")
+        raise
+    yr, ur = pallas_lsum._oracle()(jnp.asarray(Li),
+                                   jnp.asarray(L21),
+                                   jnp.asarray(xb))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(upd), np.asarray(ur),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_lsum_merged_solve(monkeypatch):
+    """SLU_TRISOLVE_PALLAS=1 routes merged forward members through
+    the kernel (interpret on CPU) and still solves to the oracle."""
+    from superlu_dist_tpu.ops import pallas_lsum
+    if not pallas_lsum._HAVE_PALLAS:
+        pytest.skip("pallas unavailable")
+    monkeypatch.setenv("SLU_TRISOLVE", "merged")
+    monkeypatch.setenv("SLU_TRISOLVE_PALLAS", "1")
+    a = laplacian_3d(6)
+    xtrue, b = manufactured_rhs(a)
+    lu = factorize(a, Options(factor_dtype="float32"),
+                   backend="jax")
+    try:
+        x = solve(lu, b)
+    except Exception as e:   # noqa: BLE001 — environment lowering bug
+        msg = str(e)
+        if "func.call" in msg and "operand type mismatch" in msg:
+            pytest.skip("jax/Mosaic lowering bug in this "
+                        "environment: func.call i64/i32 operand "
+                        "mismatch")
+        raise
+    np.testing.assert_allclose(x, xtrue, rtol=1e-4, atol=1e-4)
+    assert trisolve.active_arm() == "merged+pallas"
+
+
+def test_dead_lane_trim_single_device():
+    """Single-device packs drop dead padded lanes: the packed einsum
+    batch is n_true, not the bucketed n_loc."""
+    a = laplacian_3d(8)
+    plan = plan_factorization(a, Options())
+    sched = batched.get_schedule(plan, 1)
+    ts = trisolve.get_trisolve(sched)
+    for g, gs in zip(sched.groups, ts.groups):
+        assert gs.trim == max(1, g.n_true)
+        assert gs.trim <= g.n_loc

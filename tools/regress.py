@@ -149,8 +149,14 @@ def gather(root: str) -> dict:
             add(rec.get("platform"), "flight_ab", rec)
     for rec in _read_jsonl(os.path.join(root, "SOLVE_LATENCY.jsonl")):
         if rec.get("per_rhs_ms") is not None:
-            add(rec.get("platform"), f"solve.nrhs{rec.get('nrhs')}",
-                rec)
+            # trisolve A/B records (bench.py --solve-sweep) carry an
+            # `arm` field and gate per (arm, nrhs) — a merged-arm
+            # regression fails independently of the legacy arm's
+            # ceiling; legacy records keep the historical check name
+            arm = rec.get("arm")
+            chk = (f"solve.{arm}.nrhs{rec.get('nrhs')}" if arm
+                   else f"solve.nrhs{rec.get('nrhs')}")
+            add(rec.get("platform"), chk, rec)
     for rec in _read_jsonl(os.path.join(root, "PREC_AB.jsonl")):
         if rec.get("mode") == "prec_ab":
             add(rec.get("platform"), "prec_ab", rec)
@@ -273,7 +279,7 @@ def check(history: dict, baselines: dict) -> list[dict]:
                         "ok" if ok else "fail",
                         "" if ok else "flight recorder overhead past "
                         "the declared budget"))
-            elif chk.startswith("solve.nrhs"):
+            elif chk.startswith("solve."):
                 ceil_check(p, chk, "per_rhs_ms",
                            _num(latest, "per_rhs_ms"),
                            base.get("per_rhs_ms"),
@@ -340,7 +346,7 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                     for m in ("solves_per_s", "p95_ms", "p99_ms")}
             elif chk == "flight_ab":
                 dst[chk] = {}
-            elif chk.startswith("solve.nrhs"):
+            elif chk.startswith("solve."):
                 dst[chk] = {"per_rhs_ms": _median(
                     [v for r in win
                      if (v := _num(r, "per_rhs_ms")) is not None])}

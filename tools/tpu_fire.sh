@@ -125,6 +125,20 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   timeout 1200 python "$repo/tools/solve_latency.py" \
     >> "$repo/SOLVE_LATENCY.jsonl" 2>> "$log"
   stamp "solve_latency rc=$?"
+  # 4b. Trisolve A/B at the round's HEAD (ISSUE 9): legacy level
+  #     sweep vs merged lsum trisolve per nrhs on held factors, both
+  #     arms same-moment — bench.py --solve-sweep appends arm-tagged
+  #     records to SOLVE_LATENCY.jsonl and FAILS (persisting nothing)
+  #     when merged misses its >=2x nrhs=1 contract; a second pass
+  #     prices the Pallas lsum kernel (its smoke check in step 3
+  #     armed it).  Runs before the sweep so the serving hot path's
+  #     verdict exists even if the window dies later.
+  SLU_BENCH_ASSUME_LIVE=1 timeout 1200 \
+    python "$repo/bench.py" --solve-sweep 2>> "$log"
+  stamp "solve_sweep A/B rc=$?"
+  SLU_BENCH_ASSUME_LIVE=1 SLU_TRISOLVE_PALLAS=1 timeout 1200 \
+    python "$repo/bench.py" --solve-sweep 2>> "$log"
+  stamp "solve_sweep A/B (pallas lsum) rc=$?"
   # 5. Sequential-chain arms (the latency-bound hypothesis — the
   #    round's ONE JOB, so they run BEFORE the multi-hour sweep).
   #    SLU_DIAG_UNROLL fuses more rank-1 pivot steps per XLA body;

@@ -62,7 +62,7 @@ import time
 # before re-documenting the old one.
 CHECKS = ("f32_ir_solve", "c128_pair_kernel", "c128_pair_solve",
           "c128_solve", "pallas_compile", "pallas_scatter_compile",
-          "c128_kernel")
+          "pallas_lsum_compile", "c128_kernel")
 
 
 def _build_matrix():
@@ -208,6 +208,26 @@ def run_check(name):
                 for j in range(rc_b):
                     ref[fb[k], pr[k, i], pr[k, j]] += upd[k, i, j]
         err = float(np.abs(delta - ref).max())
+        return dict(max_err=err, exact_class=bool(err < 1e-4))
+
+    if name == "pallas_lsum_compile":
+        # the fused lsum trisolve kernel certification (ISSUE 9b):
+        # Mosaic-compile the panel-solve+update kernel on the real
+        # chip and check it against the einsum oracle — green here
+        # arms the SLU_TRISOLVE_PALLAS fire-plan A/B arm
+        from superlu_dist_tpu.ops.pallas_lsum import (_oracle,
+                                                      lsum_panel)
+        rng = np.random.default_rng(7)
+        t, wb, rb, R = 8, 32, 96, 8
+        Li = rng.standard_normal((t, wb, wb)).astype(np.float32)
+        L21 = rng.standard_normal((t, rb, wb)).astype(np.float32)
+        xb = rng.standard_normal((t, wb, R)).astype(np.float32)
+        y, upd = lsum_panel(jnp.asarray(Li), jnp.asarray(L21),
+                            jnp.asarray(xb), interpret=False)
+        yr, ur = _oracle()(jnp.asarray(Li), jnp.asarray(L21),
+                           jnp.asarray(xb))
+        err = max(float(jnp.abs(y - yr).max()),
+                  float(jnp.abs(upd - ur).max()))
         return dict(max_err=err, exact_class=bool(err < 1e-4))
 
     raise ValueError(f"unknown check {name!r}")
