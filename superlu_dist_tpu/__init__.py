@@ -33,13 +33,13 @@ factor path additionally scopes "float32" locally via _hi_prec, so the
 solver's own numerics never depend on the global).  No effect on CPU
 (native f32 there)."""
 
-import os as _os
-
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
-_prec = _os.environ.get("SLU_MATMUL_PREC")
+from . import flags as _flags  # noqa: E402 — the package env gateway
+
+_prec = _flags.env_opt("SLU_MATMUL_PREC")
 if _prec is None and _jax.config.jax_default_matmul_precision is None:
     # only pin when neither the embedding application (jax config) nor
     # the operator (SLU_MATMUL_PREC) has chosen a precision — import

@@ -1,10 +1,16 @@
 """Registry of every `SLU_`-prefixed environment flag.
 
 The package and its tools grew ~50 `SLU_*` env knobs; this table is
-the single place they are all named and described.  tests/test_flags.py
-greps the package, tools/ and bench.py for `SLU_[A-Z_0-9]+` tokens and
-fails when a read is undocumented here (or when an entry here no
-longer corresponds to any read) — so the table cannot rot.
+the single place they are all named and described.  The audit lives
+in tools/slulint (rules/envreads.flag_audit): it scans the package,
+tools/ and bench.py for `SLU_[A-Z_0-9]+` tokens and fails when a
+read is undocumented here (or when an entry here no longer
+corresponds to any read) — tests/test_flags.py is a thin wrapper
+over it, and `python -m tools.slulint` gates on it too.  The
+accessors below are the package's ONLY legal way to read these
+flags (slulint's env-read rule enforces that), and they refuse
+undocumented names at runtime — so the table cannot rot in either
+direction.
 
 Convention: boolean flags take "1"/"0"; numeric flags parse int/float;
 unset means the documented default.  SUPERLU_*-prefixed knobs are the
@@ -13,6 +19,8 @@ reference's sp_ienv analog chain and live on Options fields
 """
 
 from __future__ import annotations
+
+import os
 
 # flag name -> one-line description (scope: where it is read)
 FLAGS: dict[str, str] = {
@@ -130,3 +138,54 @@ NON_FLAG_TOKENS: frozenset = frozenset({
     "SLU_COOP_",     # prefix shorthand in a batched.py comment
     "SLU_",          # the bare prefix itself (docstrings)
 })
+
+# --------------------------------------------------------------------
+# the package's ONE env gateway
+# --------------------------------------------------------------------
+#
+# Every environment read inside superlu_dist_tpu/ goes through these
+# accessors (tools/slulint's `env-read` rule fails any direct
+# os.environ read outside this module), which refuse names the FLAGS
+# table does not document — so an undocumented knob fails at its
+# first read, not just in the registry audit.  Non-SLU names the
+# package legitimately reads are declared below: external toolchain
+# knobs and the reference's sp_ienv SUPERLU_* chain (documented on
+# Options fields, options.py, per the module docstring).
+
+EXTERNAL_OK: frozenset = frozenset({
+    "XLA_FLAGS",                  # utils/compat.py, utils/cache.py
+    "JAX_COMPILATION_CACHE_DIR",  # utils/warmup.py
+})
+EXTERNAL_PREFIXES: tuple = ("SUPERLU_",)
+
+
+def _known(name: str) -> str:
+    if (name in FLAGS or name in EXTERNAL_OK
+            or name.startswith(EXTERNAL_PREFIXES)):
+        return name
+    raise KeyError(
+        f"undocumented env flag {name!r}: document it in "
+        "superlu_dist_tpu/flags.py FLAGS before reading it")
+
+
+def env_opt(name: str) -> str | None:
+    """Raw documented-flag read: the value, or None when unset (for
+    call sites that distinguish unset from empty, e.g. SLU_FLIGHT)."""
+    return os.environ.get(_known(name))
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Documented-flag read with a default ('' unless given)."""
+    return os.environ.get(_known(name), default)
+
+
+def env_int(name: str, default: int) -> int:
+    """Int-valued documented flag; empty/unset -> default."""
+    v = os.environ.get(_known(name))
+    return int(v) if v else default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float-valued documented flag; empty/unset -> default."""
+    v = os.environ.get(_known(name))
+    return float(v) if v else default

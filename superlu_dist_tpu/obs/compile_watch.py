@@ -40,10 +40,10 @@ dispatches it wraps, and pinned by the SLU_OBS=0 overhead test.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
+from .. import flags
 from . import tracer as _tracer
 
 
@@ -51,7 +51,7 @@ _EVENT_CAP = 1024
 
 
 def _cost_enabled() -> bool:
-    return os.environ.get("SLU_OBS_COST") == "1"
+    return flags.env_str("SLU_OBS_COST") == "1"
 
 
 def _leaf_sig(a):

@@ -47,10 +47,10 @@ from __future__ import annotations
 import collections
 import itertools
 import json
-import os
 import threading
 import time
 
+from .. import flags
 from . import tracer as _tracer
 
 # outcome -> the pipeline stage that failed it (the coarse map; the
@@ -306,11 +306,11 @@ def run_drain_hooks() -> None:
 
 
 def _env_enabled() -> bool:
-    v = os.environ.get("SLU_FLIGHT")
+    v = flags.env_opt("SLU_FLIGHT")
     if v is not None:
         return v not in ("", "0")
     # a JSONL sink path implies the recorder, like SLU_TRACE_JSONL
-    return bool(os.environ.get("SLU_FLIGHT_JSONL"))
+    return bool(flags.env_opt("SLU_FLIGHT_JSONL"))
 
 
 def configure(enabled: bool | None = None, ring: int | None = None,
@@ -325,13 +325,11 @@ def configure(enabled: bool | None = None, ring: int | None = None,
         if enabled is None:
             enabled = _env_enabled()
         if ring is None:
-            ring = int(os.environ.get("SLU_FLIGHT_RING", "256")
-                       or "256")
+            ring = flags.env_int("SLU_FLIGHT_RING", 256)
         if sample is None:
-            sample = int(os.environ.get("SLU_FLIGHT_SAMPLE", "1")
-                         or "1")
+            sample = flags.env_int("SLU_FLIGHT_SAMPLE", 1)
         if jsonl_path is None:
-            jsonl_path = os.environ.get("SLU_FLIGHT_JSONL") or None
+            jsonl_path = flags.env_opt("SLU_FLIGHT_JSONL") or None
         old = _recorder
         if old is not None:
             old.close()

@@ -41,9 +41,10 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import os
 import threading
 import time
+
+from .. import flags
 
 # n-bucket edges: the serve working set spans toy (tests) to the
 # measured n=27k production class; coarse decades keep key
@@ -276,7 +277,7 @@ def configure(spec: str | None = None) -> SloEngine | None:
     from .registry import REGISTRY
     with _lock:
         if spec is None:
-            spec = os.environ.get("SLU_SLO", "")
+            spec = flags.env_str("SLU_SLO")
         old = _engine
         if old is not None:
             REGISTRY.unregister("slo", old)

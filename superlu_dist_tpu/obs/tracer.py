@@ -35,6 +35,8 @@ import sys
 import threading
 import time
 
+from .. import flags
+
 
 _EVENT_CAP = 262144
 
@@ -230,18 +232,18 @@ _lock = threading.Lock()
 
 
 def resolve_trace_path() -> str | None:
-    v = os.environ.get("SLU_TRACE", "")
+    v = flags.env_str("SLU_TRACE")
     if v in ("", "0"):
         return None
     return "last.trace.json" if v == "1" else v
 
 
 def _env_enabled() -> bool:
-    obs = os.environ.get("SLU_OBS")
+    obs = flags.env_opt("SLU_OBS")
     if obs is not None:
         return obs not in ("", "0")
     return (resolve_trace_path() is not None
-            or bool(os.environ.get("SLU_TRACE_JSONL")))
+            or bool(flags.env_opt("SLU_TRACE_JSONL")))
 
 
 def configure(enabled: bool | None = None,
@@ -257,7 +259,7 @@ def configure(enabled: bool | None = None,
         if trace_path is None:
             trace_path = resolve_trace_path()
         if jsonl_path is None:
-            jsonl_path = os.environ.get("SLU_TRACE_JSONL") or None
+            jsonl_path = flags.env_opt("SLU_TRACE_JSONL") or None
         old = _tracer
         if old is not None:
             old.close()

@@ -38,13 +38,13 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import obs
+from .. import flags, obs
 from ..plan.plan import FactorPlan
 from .dense_lu import partial_lu_batch, unit_lower_inverse, upper_inverse
 
@@ -343,16 +343,14 @@ def _level_merge_on() -> bool:
     (cost-bounded; see the merge block in build_schedule).  Off by
     default — on CPU the padded flops are real cost; the accelerator
     A/B decides."""
-    import os
-    return os.environ.get("SLU_LEVEL_MERGE", "0") == "1"
+    return flags.env_str("SLU_LEVEL_MERGE", "0") == "1"
 
 
 def _level_merge_limit() -> float:
     """Padded/original cell-ratio bound for level merging
     (SLU_LEVEL_MERGE_LIMIT, default 1.5)."""
-    import os
     try:
-        v = float(os.environ.get("SLU_LEVEL_MERGE_LIMIT", "1.5"))
+            v = flags.env_float("SLU_LEVEL_MERGE_LIMIT", 1.5)
     except ValueError:
         v = 1.5
     return max(1.0, v)
@@ -414,8 +412,7 @@ def _ea_block_on() -> bool:
     element gather/scatter — the answer to TPU_PROFILE_r05's
     50–200 MB/s slab↔GEMM-buffer fusions.  =0 restores the pure
     element formulation for A/B."""
-    import os
-    return os.environ.get("SLU_EA_BLOCK", "1").strip().lower() \
+    return flags.env_str("SLU_EA_BLOCK", "1").strip().lower() \
         not in ("0", "false", "off")
 
 
@@ -423,9 +420,8 @@ def _ea_block_min_run() -> int:
     """Minimum contiguous-run length for the block lane
     (SLU_EA_BLOCK_MIN_RUN, default 8): shorter runs stay on the
     element path, where per-copy dispatch would dominate."""
-    import os
     try:
-        return max(2, int(os.environ.get("SLU_EA_BLOCK_MIN_RUN", "8")))
+            return max(2, flags.env_int("SLU_EA_BLOCK_MIN_RUN", 8))
     except ValueError:
         return 8
 
@@ -462,9 +458,8 @@ def _plan_child_blocks(ps_row, min_run: int | None = None,
 def _coop_mb_min() -> int:
     """Minimum padded front size for cooperative (column-sharded)
     factorization; SLU_COOP_MB overrides, 0 disables."""
-    import os
     try:
-        return int(os.environ.get("SLU_COOP_MB", "256"))
+            return flags.env_int("SLU_COOP_MB", 256)
     except (TypeError, ValueError):
         return 256
 
@@ -475,8 +470,7 @@ def _coop_sharded_on() -> bool:
     scheme's recombination gather was measured at ~64% of step traffic
     at 16 devices (tests/test_coop16.py); SLU_COOP_SHARDED=0 restores
     it for A/B."""
-    import os
-    return os.environ.get("SLU_COOP_SHARDED", "1").strip().lower() \
+    return flags.env_str("SLU_COOP_SHARDED", "1").strip().lower() \
         not in ("0", "false", "off")
 
 
@@ -496,8 +490,7 @@ def _coop_solve_rotate() -> bool:
     distributed subtrees below).  Default OFF by that cost model —
     tests/test_coop16.py pins both designs' sync counts and the flop
     balance this flag restores."""
-    import os
-    return os.environ.get("SLU_COOP_SOLVE_ROTATE", "0") \
+    return flags.env_str("SLU_COOP_SOLVE_ROTATE", "0") \
         .strip().lower() in ("1", "true", "on")
 
 
@@ -506,9 +499,8 @@ def _coop_block() -> int:
     owner(g) = (g // B) % ndev (SRC/superlu_defs.h:357-382 analog).
     B=1 (pure cyclic) maximizes balance on the arbitrary struct-column
     subsets fronts carry; SLU_COOP_B overrides."""
-    import os
     try:
-        return max(1, int(os.environ.get("SLU_COOP_B", "1")))
+            return max(1, flags.env_int("SLU_COOP_B", 1))
     except (TypeError, ValueError):
         return 1
 
@@ -1675,14 +1667,13 @@ def staged_enabled(sched) -> bool:
     """Use per-group staged execution?  SLU_STAGED=1 forces on, =0
     forces off; default: on past SLU_STAGED_MIN_GROUPS groups (the
     regime where one fused program out-compiles its own runtime)."""
-    import os
-    v = os.environ.get("SLU_STAGED", "auto").strip().lower()
+    v = flags.env_str("SLU_STAGED", "auto").strip().lower()
     if v in ("1", "true", "on"):
         return True
     if v in ("0", "false", "off"):
         return False
     try:
-        thresh = int(os.environ.get("SLU_STAGED_MIN_GROUPS", "96"))
+            thresh = flags.env_int("SLU_STAGED_MIN_GROUPS", 96)
     except ValueError:
         thresh = 96
     return len(sched.groups) > thresh
@@ -2032,8 +2023,11 @@ def _solve_device_common(lu, b: np.ndarray, trans: bool):
                                  _thresh_for(lu.plan, lu.dtype),
                                  pair=pair)
         bj = jnp.asarray(bin_)
+        # `trans` passed POSITIONALLY: a static_argnames keyword
+        # call drops jax to the slow python dispatch path (the PR 7
+        # lesson, enforced by slulint's static-kwarg rule)
         X = solve_fn(lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat,
-                     bj, trans=trans)
+                     bj, trans)
         # the EXECUTED signature's program cost — the solve wrapper
         # serves the whole nrhs bucket ladder, so a shared last-miss
         # field would misattribute (a 1-wide solve adopting the
@@ -2042,7 +2036,7 @@ def _solve_device_common(lu, b: np.ndarray, trans: bool):
         # cross-attribute either
         obs.stamp_cost("solve", solve_fn.cost_of(
             lu.L_flat, lu.U_flat, lu.Li_flat, lu.Ui_flat, bj,
-            trans=trans))
+            trans))
     out = np.asarray(X)
     if pair:
         out = _pair_decode_sol(out, xdt)
@@ -2293,9 +2287,8 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
                                      nnz=nnz_a)
     layout = spmv_layout(nnz_a, n, ell_w)
     if doubleword and layout != "ell":
-        import os
-        if os.environ.get("SLU_SPMV_LAYOUT",
-                          "auto").strip().lower() != "coo":
+        if flags.env_str("SLU_SPMV_LAYOUT",
+                         "auto").strip().lower() != "coo":
             # the df64 COO lane's scatter-add cannot carry a
             # compensated sum (its row accumulation stays fp32-class,
             # precision/doubleword.df64_coo_spmv) — for a doubleword

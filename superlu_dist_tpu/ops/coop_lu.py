@@ -41,7 +41,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .batched import psum_exact as _psum
 from .dense_lu import _newton_tri_inverse, _tiny_replace, _DIAG_UNROLL

@@ -21,10 +21,11 @@ padded factorization equal the true one.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
+
+from .. import flags
 
 
 def _env_unroll(default: int = 8) -> int:
@@ -32,7 +33,7 @@ def _env_unroll(default: int = 8) -> int:
     shapes only, so a mid-process change could never take effect
     anyway); malformed values fall back to the default."""
     try:
-        v = int(os.environ.get("SLU_DIAG_UNROLL", default))
+        v = flags.env_int("SLU_DIAG_UNROLL", default)
     except (TypeError, ValueError):
         return default
     return v if v >= 1 else default

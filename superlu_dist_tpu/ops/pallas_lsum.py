@@ -29,11 +29,12 @@ in the f32 rounding class, not a precision-mode delta.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import flags
 
 try:  # pallas is part of jax, but guard exotic builds
     from jax.experimental import pallas as pl
@@ -67,7 +68,7 @@ def enabled(dtype) -> bool:
     dtype = np.dtype(dtype)
     if dtype.kind == "c" or dtype.itemsize == 8:
         return False
-    return os.environ.get("SLU_TRISOLVE_PALLAS", "0") == "1"
+    return flags.env_str("SLU_TRISOLVE_PALLAS", "0") == "1"
 
 
 # per-front VMEM residency: Li + L21 + xb + y + upd (+ an output

@@ -23,11 +23,12 @@ compares them elementwise under a small tolerance).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import flags
 
 try:  # pallas is part of jax, but guard exotic builds
     from jax.experimental import pallas as pl
@@ -80,7 +81,7 @@ def enabled(dtype) -> bool:
         # f64: the kernel traces with x64 disabled and Mosaic has no
         # 64-bit lowering — always the XLA path
         return False
-    flag = os.environ.get("SLU_TPU_PALLAS", "0")
+    flag = flags.env_str("SLU_TPU_PALLAS", "0")
     return flag == "1"
 
 
@@ -264,7 +265,7 @@ def partial_lu_batch_pallas(F, thresh, *, wb: int,
         nb = _pick_nb(wb)
     else:
         nb = next((d for d in (256, 128) if wb % d == 0), 0)
-    if (os.environ.get("SLU_TPU_PALLAS_COLUMN", "0") == "1"
+    if (flags.env_str("SLU_TPU_PALLAS_COLUMN", "0") == "1"
             or nb == 0 or mb % 8 != 0):
         kern = functools.partial(_lu_kernel, wb=wb, mb=mb)
     else:

@@ -41,11 +41,12 @@ the f64 refinement loop owns the residual either way.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import flags
 
 try:  # pallas is part of jax, but guard exotic builds
     from jax.experimental import pallas as pl
@@ -80,7 +81,7 @@ def enabled(dtype) -> bool:
     dtype = np.dtype(dtype)
     if dtype.kind == "c" or dtype.itemsize == 8:
         return False
-    return os.environ.get("SLU_TPU_PALLAS_SCATTER", "0") == "1"
+    return flags.env_str("SLU_TPU_PALLAS_SCATTER", "0") == "1"
 
 
 # front tile + child block + two one-hot factors, input and output

@@ -30,11 +30,11 @@ and the |A|·|x| backward-error denominator.
 from __future__ import annotations
 
 import dataclasses
-import os
 
-import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import flags
 
 from ..sparse import CSRMatrix
 
@@ -121,7 +121,7 @@ def coo_spmv_df64(rows, cols, vals_hi, vals_lo, x_hi, x_lo, n: int):
 
 def _ell_waste_limit() -> float:
     try:
-        return float(os.environ.get("SLU_SPMV_ELL_WASTE", "4"))
+        return flags.env_float("SLU_SPMV_ELL_WASTE", 4.0)
     except ValueError:
         return 4.0
 
@@ -131,7 +131,7 @@ def spmv_layout(nnz: int, n_rows: int, w: int) -> str:
     auto (default).  Auto takes ELL unless the fixed-band padding
     exceeds the waste limit — a near-dense row would turn the O(nnz)
     product into O(n·w)."""
-    mode = os.environ.get("SLU_SPMV_LAYOUT", "auto").strip().lower()
+    mode = flags.env_str("SLU_SPMV_LAYOUT", "auto").strip().lower()
     if mode in ("ell", "coo"):
         return mode
     return ("ell" if w * n_rows <= _ell_waste_limit() * max(nnz, 1)
@@ -173,8 +173,8 @@ class DeviceSpMV:
         src, w = ell_from_csr(a.indptr, a.indices)
         layout = spmv_layout(len(vals), a.m, w)
         if doubleword and layout != "ell" \
-                and os.environ.get("SLU_SPMV_LAYOUT",
-                                   "auto").strip().lower() != "coo":
+                and flags.env_str("SLU_SPMV_LAYOUT",
+                                  "auto").strip().lower() != "coo":
             # precision outranks the pad-waste heuristic for df64
             # residuals (the COO lane's scatter sum stays fp32-class)
             layout = "ell"
@@ -221,3 +221,34 @@ class DeviceSpMV:
                                  self.ell_vals_lo, x_hi, x_lo)
         return coo_spmv_df64(self.rows, self.cols, self.vals,
                              self.vals_lo, x_hi, x_lo, self.n)
+
+
+# --------------------------------------------------------------------
+# HLO contract registry declarations (tools/slulint/contracts.py)
+# --------------------------------------------------------------------
+
+def _contract_build_residual_ell():
+    import jax
+    import jax.numpy as jnp
+
+    from ..options import Options
+    from ..ops.batched import make_fused_solver
+    from ..plan.plan import plan_factorization
+    from ..utils.testmat import laplacian_2d
+    a = laplacian_2d(10)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    step = make_fused_solver(plan, dtype="float32")
+    fn = jax.jit(step.resid_fn)
+    return fn, (jnp.zeros(len(plan.coo_rows)),
+                jnp.zeros((a.n, 2)), jnp.zeros((a.n, 2))), {}
+
+
+HLO_CONTRACTS = (
+    {"name": "residual.ell_spmv",
+     "env": {"SLU_SPMV_LAYOUT": "ell"},
+     "contracts": ("no_scatter", "no_host_callback"),
+     "build": _contract_build_residual_ell,
+     "note": "the jitted refinement residual is the per-iteration "
+             "hot loop; ELL exists to keep it scatter-free (PR 1 — "
+             "scatters ran at 50-600 MB/s on TPU)"},
+)

@@ -53,13 +53,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 import threading
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import flags
 
 
 # --------------------------------------------------------------------
@@ -73,7 +74,7 @@ def trisolve_mode() -> str:
     arm is bitwise-identical to legacy by construction, so the flag
     exists for A/B pricing (bench.py --solve-sweep) and rollback, not
     correctness."""
-    v = os.environ.get("SLU_TRISOLVE", "auto").strip().lower()
+    v = flags.env_str("SLU_TRISOLVE", "auto").strip().lower()
     if v in ("legacy", "0", "off"):
         return "legacy"
     return "merged"
@@ -87,8 +88,7 @@ def merge_cells_limit() -> int:
     Groups above it stand alone — their einsums are real work and
     chaining them into one dispatch buys nothing."""
     try:
-        return max(0, int(os.environ.get("SLU_TRISOLVE_MERGE_CELLS",
-                                         "65536")))
+        return max(0, flags.env_int("SLU_TRISOLVE_MERGE_CELLS", 65536))
     except ValueError:
         return 65536
 
@@ -99,8 +99,7 @@ def seg_cells_limit() -> int:
     staged program size so segment compiles stay in the per-group
     compile class."""
     try:
-        return max(1, int(os.environ.get("SLU_TRISOLVE_SEG_CELLS",
-                                         "1048576")))
+        return max(1, flags.env_int("SLU_TRISOLVE_SEG_CELLS", 1048576))
     except ValueError:
         return 1048576
 
@@ -112,8 +111,8 @@ def mesh_merged_on() -> bool:
     meshes while the merged arm's collective behavior is priced on
     real hardware (single-device auto is merged: it is
     bitwise-identical and strictly fewer ops)."""
-    return os.environ.get("SLU_TRISOLVE",
-                          "auto").strip().lower() == "merged"
+    return flags.env_str("SLU_TRISOLVE",
+                        "auto").strip().lower() == "merged"
 
 
 def active_arm(device_lu=None) -> str:
@@ -129,7 +128,7 @@ def active_arm(device_lu=None) -> str:
     mode = trisolve_mode()
     if mode != "merged":
         return mode
-    if os.environ.get("SLU_TRISOLVE_PALLAS", "0") != "1":
+    if flags.env_str("SLU_TRISOLVE_PALLAS", "0") != "1":
         return "merged"
     if device_lu is not None:
         from . import pallas_lsum
@@ -656,7 +655,7 @@ def sweep(ts: TrisolveSchedule, packs, b, dtype, trans: bool,
 def _packed_key(dtype, pair: bool):
     return ("packed", np.dtype(dtype).str, bool(pair),
             merge_cells_limit(), seg_cells_limit(),
-            os.environ.get("SLU_TRISOLVE_PALLAS", "0"))
+            flags.env_str("SLU_TRISOLVE_PALLAS", "0"))
 
 
 def _solve_packed_fn(sched, dtype, pair: bool):
@@ -842,3 +841,67 @@ def staged_sweeps(ts: TrisolveSchedule, packs, bf, dtype,
                                  trans=trans)
     return _final_gather(XF, jnp.asarray(ts.final_idx),
                          cplx and not pair)
+
+
+# --------------------------------------------------------------------
+# HLO contract registry declarations (tools/slulint/contracts.py)
+# --------------------------------------------------------------------
+#
+# The merged trisolve's structural guarantees, declared next to the
+# code that earns them and checked by `python -m tools.slulint` (and
+# tests/test_slulint.py) by lowering at a representative signature.
+# tests/test_trisolve.py's former inline HLO regex pin is now a
+# one-line registry assertion against these entries.
+
+def _contract_build_packed_solve():
+    import jax.numpy as jnp
+
+    from .. import factorize
+    from ..options import Options
+    from ..utils.testmat import laplacian_3d
+    a = laplacian_3d(8)
+    lu = factorize(a, Options(factor_dtype="float32"), backend="jax")
+    d = lu.device_lu
+    fn = _solve_packed_fn(d.schedule, d.dtype, False)[0]
+    return fn, (get_packs(d), jnp.zeros((a.n, 1), jnp.float32)), {}
+
+
+def _contract_build_staged_fwd_segment():
+    import jax.numpy as jnp
+
+    from .. import factorize
+    from ..options import Options
+    from ..utils.testmat import laplacian_3d
+    a = laplacian_3d(8)
+    lu = factorize(a, Options(factor_dtype="float32"), backend="jax")
+    d = lu.device_lu                    # StagedLU under SLU_STAGED=1
+    ts = get_trisolve(d.schedule)
+    packs = get_packs(d)
+    B, UPD, Y = init_lsum_buffers(ts, jnp.zeros((a.n, 1), jnp.float32))
+    seg = ts.segments[0]
+    metas = seg_metas(ts, seg, False)
+    pk = tuple(packs[i] for i in seg)
+    ix = tuple(ts.groups[i].dev(squeeze=True) for i in seg)
+    return (_staged_fwd_segment, (B, UPD, Y, pk, ix),
+            dict(metas=metas, trans=False))
+
+
+HLO_CONTRACTS = (
+    {"name": "trisolve.packed_solve",
+     "phase": "solve",
+     "env": {"SLU_TRISOLVE": "merged"},
+     "contracts": ("no_scatter", "no_host_callback"),
+     "build": _contract_build_packed_solve,
+     "note": "the legacy sweep's scatter-adds were the slowest op "
+             "class at nrhs=1 (PR 7); the packed lsum layout must "
+             "stay scatter-free"},
+    {"name": "trisolve.staged_fwd_segment",
+     "phase": "solve",
+     "env": {"SLU_TRISOLVE": "merged", "SLU_STAGED": "1"},
+     "contracts": ("donation_honored", "no_scatter",
+                   "no_host_callback"),
+     "build": _contract_build_staged_fwd_segment,
+     "note": "UPD/Y stream through the segment chain in place; a "
+             "dropped donation doubles the staged solve's buffer "
+             "traffic silently"},
+)

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import os
+
+from . import flags as _flags
 
 
 class YesNo(enum.Enum):
@@ -117,9 +118,9 @@ def solve_options_key(options: "Options") -> tuple:
 
 def _env_int(name: str, default: int) -> int:
     """Env-var override, mirroring sp_ienv_dist's SUPERLU_* chain
-    (SRC/sp_ienv.c:60-146)."""
-    v = os.environ.get(name)
-    return int(v) if v else default
+    (SRC/sp_ienv.c:60-146) — routed through the flags.py gateway,
+    whose EXTERNAL_PREFIXES allowance admits SUPERLU_* names."""
+    return _flags.env_int(name, default)
 
 
 @dataclasses.dataclass
@@ -189,7 +190,7 @@ class Options:
     # "plain"/"fp64" force the two legacy modes.  Resolved ONLY
     # through precision.policy.resolve_residual_mode.
     residual_mode: str = dataclasses.field(
-        default_factory=lambda: os.environ.get(
+        default_factory=lambda: _flags.env_str(
             "SLU_PREC_RESIDUAL", "auto") or "auto")
     # Triangular-sweep RHS dtype (PrecisionPolicy.solve_dtype): None
     # follows the factors' promotion rule (solve_rhs_dtype in

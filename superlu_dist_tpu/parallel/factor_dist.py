@@ -791,8 +791,8 @@ def _rhs_sharded_auto(nrhs: int, ndev: int) -> bool:
     """Pick the rhs-sharded sweep when the column slice amortizes the
     one-time factor gather (nrhs ≥ 2·ndev).  SLU_RHS_SHARDED=1/0
     forces."""
-    import os
-    v = os.environ.get("SLU_RHS_SHARDED", "auto").strip().lower()
+    from ..flags import env_str
+    v = env_str("SLU_RHS_SHARDED", "auto").strip().lower()
     if v in ("1", "true", "on"):
         return True
     if v in ("0", "false", "off"):

@@ -51,11 +51,11 @@ quality class.
 
 from __future__ import annotations
 
-import os
 from typing import List
 
 import numpy as np
 
+from .. import flags
 from ..plan import mindeg
 from ..plan.nested import (_induced_subgraph, _pseudo_peripheral,
                            nd_order)
@@ -68,7 +68,7 @@ def _cluster_cap(n: int, nparts: int) -> int:
     nodes per target part — separator quality needs resolution at the
     coarse level (the multilevel-ND coarsest-size rule)."""
     try:
-        v = int(os.environ.get("SLU_DORDER_CLUSTER", "16"))
+        v = flags.env_int("SLU_DORDER_CLUSTER", 16)
     except ValueError:
         v = 16
     return max(1, min(v, n // (64 * max(1, nparts))))

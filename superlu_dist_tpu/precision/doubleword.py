@@ -282,3 +282,80 @@ def split_f64(v: np.ndarray):
 def join_f64(hi, lo) -> np.ndarray:
     """Recombine a (hi, lo) pair into float64 on the host."""
     return np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+
+
+# --------------------------------------------------------------------
+# HLO contract registry declarations (tools/slulint/contracts.py)
+# --------------------------------------------------------------------
+
+def _dw_fused_setup():
+    from ..options import Options
+    from ..ops.batched import make_fused_solver
+    from ..plan.plan import plan_factorization
+    from ..utils.testmat import laplacian_2d
+    a = laplacian_2d(12)
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    step = make_fused_solver(plan, dtype="float32",
+                             residual_mode="doubleword")
+    return a, step
+
+
+def _contract_build_dw_core():
+    a, step = _dw_fused_setup()
+    vh = np.zeros(a.nnz, np.float32)
+    bh = np.zeros((a.n, 1), np.float32)
+    return step._core, (vh, vh, bh, bh), {}
+
+
+def _contract_build_dw_residual():
+    import jax
+    import jax.numpy as jnp
+    a, step = _dw_fused_setup()
+    fn = jax.jit(step.resid_fn_df)
+    args = ((jnp.zeros(a.nnz, jnp.float32),) * 3
+            + (jnp.zeros((a.n, 1), jnp.float32),) * 4)
+    return fn, args, {}
+
+
+def _contract_check_eft_mul_survives_jit():
+    """The PR 4 fp-contraction hazard has no HLO-text signature (the
+    contraction happens in the LLVM backend): the check IS bitwise
+    jit==eager equality of a traced-scalar df_mul_f, the exact probe
+    that caught it."""
+    import jax
+    rng = np.random.default_rng(16)
+    x = rng.standard_normal(512)
+    pair = split_f64(x)
+    f = np.float32(3.0)
+    jh, jl = jax.jit(df_mul_f)(pair, f)
+    eh, el = df_mul_f(pair, f)
+    if not np.array_equal(np.asarray(jh), np.asarray(eh)):
+        return False, ("jit vs eager HI words differ bitwise — the "
+                       "scalar-broadcast EFT was fp-contracted "
+                       "(_match_shapes regressed)")
+    lo_err = np.max(np.abs(np.asarray(jl) - np.asarray(el))
+                    / np.abs(3.0 * x))
+    if lo_err >= 2.0 ** -44:
+        return False, (f"LO-word jit/eager drift {lo_err:.3e} is "
+                       "fp32-scale, not df64-class")
+    return True, ""
+
+
+HLO_CONTRACTS = (
+    {"name": "df64.fused_core",
+     "phase": "fused_step_dw",
+     "contracts": ("no_f64", "no_host_callback"),
+     "build": _contract_build_dw_core,
+     "note": "the whole df64 refine program must carry ZERO f64 ops "
+             "— f64 is emulated on TPU; one leak silently voids the "
+             "mixed-precision win (PR 4 acceptance)"},
+    {"name": "df64.residual",
+     "contracts": ("no_scatter", "no_f64"),
+     "build": _contract_build_dw_residual,
+     "note": "the per-iteration df64 residual: ELL lane (no scatter) "
+             "and fp32-pair arithmetic only"},
+    {"name": "df64.eft_mul",
+     "check": _contract_check_eft_mul_survives_jit,
+     "note": "error-free transformations must survive XLA:CPU "
+             "fp-contraction through jit (PR 4's _match_shapes fix)"},
+)

@@ -48,11 +48,11 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import os
 from typing import Optional
 
 import numpy as np
 
+from .. import flags
 from ..options import IterRefine, Options
 
 
@@ -145,7 +145,7 @@ def ladder() -> tuple:
     """Factor-dtype rungs, coarse → fine.  SLU_PREC_LADDER overrides
     (comma list of dtype names); entries are validated and sorted by
     decreasing eps so a shuffled override still climbs correctly."""
-    raw = os.environ.get("SLU_PREC_LADDER", "")
+    raw = flags.env_str("SLU_PREC_LADDER")
     names = tuple(s.strip() for s in raw.split(",") if s.strip()) \
         or _DEFAULT_LADDER
     return tuple(sorted(names, key=_eps, reverse=True))

@@ -31,10 +31,11 @@ in a spec is an error, not silence.
 from __future__ import annotations
 
 import hashlib
-import os
 import random
 import threading
 import time
+
+from .. import flags
 
 SITES = ("factor_raise", "factor_nan", "store_flip", "flusher_raise",
          "latency")
@@ -112,13 +113,13 @@ _POLICY: ChaosPolicy | None = None
 def install(spec: str, seed: int | None = None) -> ChaosPolicy:
     global _POLICY
     if seed is None:
-        seed = int(os.environ.get("SLU_CHAOS_SEED", "0") or "0")
+        seed = flags.env_int("SLU_CHAOS_SEED", 0)
     _POLICY = ChaosPolicy(spec, seed=seed)
     return _POLICY
 
 
 def install_from_env() -> ChaosPolicy | None:
-    spec = os.environ.get("SLU_CHAOS", "").strip()
+    spec = flags.env_str("SLU_CHAOS").strip()
     return install(spec) if spec else None
 
 

@@ -45,6 +45,7 @@ import threading
 
 import numpy as np
 
+from .. import flags
 from ..models.gssvx import (LUFactorization, factor_arrays,
                             factors_finite)
 from ..sparse import CSRMatrix
@@ -328,5 +329,5 @@ class FactorStore:
 
 def store_from_env(metrics=None) -> FactorStore | None:
     """The `SLU_FT_STORE=dir` hookup used by FactorCache."""
-    d = os.environ.get("SLU_FT_STORE", "").strip()
+    d = flags.env_str("SLU_FT_STORE").strip()
     return FactorStore(d, metrics=metrics) if d else None

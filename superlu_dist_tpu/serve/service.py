@@ -41,13 +41,13 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import dataclasses
-import os
 import threading
 import time
 from concurrent.futures import Future
 
 import numpy as np
 
+from .. import flags
 from ..models.gssvx import LUFactorization, solve
 from ..obs import flight, slo
 from ..options import Options, merge_solve_options, solve_options_key
@@ -156,8 +156,8 @@ class ServeConfig:
     # event `tier_berr`), so subsequent requests re-key to a genuine
     # full-precision factorization.
     dtype_tiers: bool = dataclasses.field(
-        default_factory=lambda: bool(int(
-            os.environ.get("SLU_PREC_TIERS", "0") or "0")))
+        default_factory=lambda: bool(flags.env_int("SLU_PREC_TIERS",
+                                                   0)))
     # --- resilience (resilience/) ---
     # durable factor store directory; None falls through to the
     # cache's own SLU_FT_STORE env default

@@ -22,7 +22,6 @@ directory no later run looks at.
 from __future__ import annotations
 
 import hashlib
-import os
 import platform
 import re
 
@@ -121,9 +120,9 @@ def _fingerprint(include_isa: bool) -> str:
     # portability guard for live-migrating VMs) must not share a dir
     # with full-ISA artifacts from the same host
     if include_isa:
-        import os
+        from .. import flags as _flags
         m = re.search(r"--xla_cpu_max_isa=(\S+)",
-                      os.environ.get("XLA_FLAGS", ""))
+                      _flags.env_str("XLA_FLAGS"))
         if m:
             parts.append(f"isa={m.group(1).lower()}")
     key = "|".join(parts)

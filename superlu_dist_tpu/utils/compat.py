@@ -44,7 +44,8 @@ def set_cpu_devices(n: int) -> bool:
     except AttributeError:
         pass
     import re
-    flags = os.environ.get("XLA_FLAGS", "")
+    from ..flags import env_str
+    flags = env_str("XLA_FLAGS")
     opt = f"--xla_force_host_platform_device_count={n}"
     if "xla_force_host_platform_device_count" in flags:
         # rewrite a conflicting pre-existing count instead of silently

@@ -20,6 +20,8 @@ import threading
 
 import numpy as np
 
+from .. import flags
+
 _I64 = ctypes.POINTER(ctypes.c_int64)
 _F64 = ctypes.POINTER(ctypes.c_double)
 
@@ -106,7 +108,7 @@ def _load():
     with _lock:
         if _lib is not None or _failed:
             return _lib
-        if os.environ.get("SLU_TPU_NO_NATIVE"):
+        if flags.env_opt("SLU_TPU_NO_NATIVE"):
             _failed = True
             return None
         path = _build()
@@ -266,7 +268,7 @@ def cpuid_words_fast() -> np.ndarray:
     executables landed in a dir no later run looked at).  Returns an
     empty array when no helper can be produced (caller falls back to
     the /proc fingerprint)."""
-    if os.environ.get("SLU_TPU_NO_NATIVE"):
+    if flags.env_opt("SLU_TPU_NO_NATIVE"):
         # the documented no-native-code opt-out covers the tiny helper
         # too: no g++ spawns from conftest/bench startup; caller falls
         # back to the /proc fingerprint

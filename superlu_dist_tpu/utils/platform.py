@@ -31,6 +31,8 @@ import os
 
 import numpy as np
 
+from .. import flags
+
 
 def complex_pair_enabled() -> bool:
     """Real-pair complex lowering (ops/pair_lu +
@@ -41,7 +43,7 @@ def complex_pair_enabled() -> bool:
     oracle-verified on CPU; tools/tpu_smoke.py's `c128_pair_solve`
     check is the hardware certification lever — flip the default here
     once a window certifies it clean on-chip)."""
-    return os.environ.get("SLU_COMPLEX_PAIR", "0") == "1"
+    return flags.env_str("SLU_COMPLEX_PAIR", "0") == "1"
 
 
 def complex_needs_cpu(dtype, pair_capable: bool = True) -> bool:
@@ -55,7 +57,7 @@ def complex_needs_cpu(dtype, pair_capable: bool = True) -> bool:
     measured compile wedge."""
     if not np.issubdtype(np.dtype(dtype), np.complexfloating):
         return False
-    if os.environ.get("SLU_COMPLEX_TPU", "0") == "1":
+    if flags.env_str("SLU_COMPLEX_TPU", "0") == "1":
         return False
     if pair_capable and complex_pair_enabled():
         return False
@@ -116,7 +118,7 @@ def complex_mesh_blocked(dtype, mesh) -> bool:
     wedge, so the mesh's own devices are the predicate."""
     if not np.issubdtype(np.dtype(dtype), np.complexfloating):
         return False
-    if os.environ.get("SLU_COMPLEX_TPU", "0") == "1":
+    if flags.env_str("SLU_COMPLEX_TPU", "0") == "1":
         return False
     return any(d.platform == "tpu"
                for d in np.asarray(mesh.devices).flat)

@@ -110,6 +110,7 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
 
     import jax
 
+    from .. import flags
     from ..ops import batched as B
 
     dtype = np.dtype(dtype)
@@ -126,7 +127,7 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
         return {"factor_programs": 0, "sweep_programs": 0,
                 "workers": 0, "secs": 0.0, "staged_inactive": True}
     if not (jax.config.jax_compilation_cache_dir
-            or os.environ.get("JAX_COMPILATION_CACHE_DIR")):
+            or flags.env_opt("JAX_COMPILATION_CACHE_DIR")):
         # AOT compiles land ONLY in the persistent cache; without one
         # the real dispatch recompiles everything and the warmup was
         # pure cost

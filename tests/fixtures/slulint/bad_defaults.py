@@ -1,0 +1,6 @@
+"""Seeded violation: mutable default argument."""
+
+
+def accumulate(x, acc=[]):
+    acc.append(x)
+    return acc

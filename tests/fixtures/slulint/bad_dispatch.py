@@ -1,0 +1,13 @@
+"""Seeded violation: static_argnames jit called with keywords."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def kernel(x, mode="fast"):
+    return x if mode == "fast" else -x
+
+
+def dispatch(x):
+    return kernel(x, mode="slow")      # the measured ms/call tax

@@ -1,0 +1,10 @@
+"""Seeded violation: direct env read outside the flags.py gateway."""
+import os
+
+
+def read_knob():
+    return os.environ.get("SLU_SOME_KNOB", "0")
+
+
+def read_knob_getenv():
+    return os.getenv("SLU_OTHER_KNOB")

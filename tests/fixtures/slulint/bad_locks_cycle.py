@@ -1,0 +1,18 @@
+"""Seeded violation: lock-order cycle A -> B and B -> A."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_lock = threading.Lock()
+        self._free_lock = threading.Lock()
+
+    def take(self):
+        with self._alloc_lock:
+            with self._free_lock:
+                return 1
+
+    def give(self):
+        with self._free_lock:
+            with self._alloc_lock:
+                return 0

@@ -1,0 +1,16 @@
+"""Seeded violation: the PR 5 flusher self-join deadlock class —
+close() joins the worker thread with no current_thread() guard, so a
+close driven from the worker's own future callback deadlocks."""
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._worker.join()              # no identity guard

@@ -1,0 +1,8 @@
+"""Seeded violation: untyped raise + bare except in serve scope."""
+
+
+def route(key, table):
+    try:
+        return table[key]
+    except:                              # seeded bare-except
+        raise RuntimeError(f"lookup failed for {key}")

@@ -20,7 +20,6 @@ Three layers of pins:
     inside the *name* df64 in module metadata.)
 """
 
-import re
 
 import numpy as np
 import pytest
@@ -29,8 +28,11 @@ import jax
 import jax.numpy as jnp
 
 from superlu_dist_tpu.precision import doubleword as dw
-
-F64_HAS_F64 = re.compile(r"(?<!d)f64")
+# HLO text predicates live in ONE place now — the slulint contract
+# registry (tools/slulint/contracts.py); the local (?<!d)f64 regex
+# here was one of three drifting copies
+from tools.slulint.contracts import (assert_contract, has_f64,
+                                     scatter_count)
 
 
 def _rand(n, scale=1.0, seed=0):
@@ -199,8 +201,8 @@ def test_df64_ell_spmv_hlo_clean():
     txt = f.lower(jnp.zeros((n, w), jnp.int32),
                   *(jnp.zeros((n, w), jnp.float32),) * 2,
                   *(jnp.zeros(n, jnp.float32),) * 2).as_text()
-    assert not F64_HAS_F64.search(txt)
-    assert "scatter" not in txt
+    assert not has_f64(txt)
+    assert scatter_count(txt) == 0
 
 
 def test_df64_coo_spmv_term_exact_sum_fp32_class():
@@ -273,12 +275,12 @@ def test_fused_doubleword_hlo_has_zero_f64_ops():
     vh = np.zeros(a.nnz, np.float32)
     bh = np.zeros((a.n, 1), np.float32)
     txt = step._core.lower(vh, vh, bh, bh).as_text()
-    assert not F64_HAS_F64.search(txt), "f64 leaked into the df64 path"
+    assert not has_f64(txt), "f64 leaked into the df64 path"
     control = mk(plan, dtype="float32", residual_mode="fp64")
     txt64 = jax.jit(control).lower(
         jnp.zeros(a.nnz, np.float64),
         jnp.zeros((a.n, 1), np.float64)).as_text()
-    assert F64_HAS_F64.search(txt64), "control build should carry f64"
+    assert has_f64(txt64), "control build should carry f64"
 
 
 def test_fused_doubleword_residual_path_scatter_free():
@@ -290,8 +292,11 @@ def test_fused_doubleword_residual_path_scatter_free():
     txt = jax.jit(step.resid_fn_df).lower(
         *(jnp.zeros(nnz, jnp.float32),) * 3,
         *(jnp.zeros((n, 1), jnp.float32),) * 4).as_text()
-    assert "scatter" not in txt
-    assert not F64_HAS_F64.search(txt)
+    assert scatter_count(txt) == 0
+    assert not has_f64(txt)
+    # the same invariant as a one-line registry assertion (what the
+    # slulint CLI gate checks every run)
+    assert_contract("df64.residual")
 
 
 def test_fused_doubleword_rejects_unsupported_combos():

@@ -1,50 +1,29 @@
-"""flags.py registry integrity: every SLU_* token in the package,
-tools/ and bench.py must be documented (or explicitly listed as a
-non-flag token), and the registry must not carry stale entries."""
+"""flags.py registry integrity — now a thin wrapper over slulint's
+`undocumented-flag` / `stale-flag` audit (tools/slulint/rules/
+envreads.flag_audit), which is the ONE source of truth: the former
+grep lived here, duplicated nothing else could reuse, and the CLI
+gate (`python -m tools.slulint`) now runs the same function.  The
+wrapper keeps tier-1 coverage (and the failure messages) unchanged."""
 
 import os
-import re
 
 from superlu_dist_tpu.flags import FLAGS, NON_FLAG_TOKENS
+from tools.slulint.rules.envreads import flag_audit
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_TOKEN = re.compile(r"SLU_[A-Z_0-9]*")
-
-
-def _source_files():
-    yield os.path.join(ROOT, "bench.py")
-    for top in ("superlu_dist_tpu", "tools"):
-        for dirpath, dirnames, filenames in os.walk(
-                os.path.join(ROOT, top)):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            for f in filenames:
-                if f.endswith(".py"):
-                    yield os.path.join(dirpath, f)
-
-
-def _tokens():
-    found = {}
-    for path in _source_files():
-        if os.path.basename(path) == "flags.py":
-            continue        # the registry itself names every flag
-        text = open(path).read()
-        for tok in _TOKEN.findall(text):
-            found.setdefault(tok, os.path.relpath(path, ROOT))
-    return found
 
 
 def test_every_flag_read_is_documented():
-    found = _tokens()
-    undocumented = {t: p for t, p in found.items()
-                    if t not in FLAGS and t not in NON_FLAG_TOKENS}
+    undocumented = {f.detail: f.path for f in flag_audit(ROOT)
+                    if f.rule == "undocumented-flag"}
     assert not undocumented, (
         f"undocumented SLU_* flags (add to superlu_dist_tpu/flags.py "
         f"FLAGS with a one-line description): {undocumented}")
 
 
 def test_no_stale_registry_entries():
-    found = set(_tokens())
-    stale = sorted(f for f in FLAGS if f not in found)
+    stale = sorted(f.detail for f in flag_audit(ROOT)
+                   if f.rule == "stale-flag")
     assert not stale, (
         f"flags.py documents flags no source file reads: {stale}")
 
@@ -53,3 +32,17 @@ def test_descriptions_are_one_line_and_nonempty():
     for name, desc in FLAGS.items():
         assert desc.strip() and "\n" not in desc, name
     assert not (set(FLAGS) & NON_FLAG_TOKENS)
+
+
+def test_accessors_refuse_undocumented_names():
+    """The runtime leg of the same contract: the flags.py env
+    gateway raises on a name the FLAGS table doesn't carry, and
+    admits declared external names (XLA_FLAGS, SUPERLU_*)."""
+    import pytest
+
+    from superlu_dist_tpu import flags
+    with pytest.raises(KeyError, match="undocumented env flag"):
+        flags.env_str("SLU_NOT_A_REAL_FLAG")
+    assert flags.env_str("XLA_FLAGS", "") is not None
+    assert flags.env_int("SUPERLU_MAXSUP", 128) >= 1
+    assert flags.env_int("SLU_FLIGHT_RING", 256) >= 1

@@ -21,8 +21,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -37,7 +35,6 @@ if _envp:
         jax.config.update("jax_platforms", _envp)
     except Exception:
         pass
-import jax.numpy as jnp
 
 
 def main():

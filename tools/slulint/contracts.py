@@ -1,0 +1,228 @@
+"""HLO contract registry: declarative compiled-program invariants.
+
+The static-pivoting design makes whole-phase jitted programs
+STATICALLY checkable — the task graph is fixed before numerics run,
+so each registered program has a verifiable HLO shape at a
+representative signature.  Modules declare contracts NEXT TO the code
+they protect as a module-level `HLO_CONTRACTS` list (ops/trisolve.py,
+ops/spmv.py, precision/doubleword.py); this module collects and
+checks them, and exports the text predicates the tests import instead
+of re-spelling regexes (the former triplicated pins in
+tests/test_trisolve.py / test_spmv_ell.py / test_doubleword.py).
+
+Entry schema (a plain dict — package modules must not import tools/):
+
+    {"name":      "trisolve.packed_solve",     # unique registry key
+     "phase":     "solve",                     # obs compile_watch label
+     "contracts": ("no_scatter", "no_host_callback"),
+     "env":       {"SLU_TRISOLVE": "merged"},  # applied around build
+     "build":     <callable>,                  # -> (fn, args, kwargs)
+     "check":     <callable>,                  # OR: -> (ok, msg)
+     "note":      "why this invariant exists"}
+
+`build` returns a lowerable callable plus representative arguments;
+the named checks run on `fn.lower(*args, **kwargs).as_text()`.
+`check` entries are semantic probes that bypass lowering (the EFT
+survival contract — PR 4's fp-contraction hazard has no HLO-text
+signature; bit-exactness through jit IS the check).  Declared `phase`
+labels are validated against the obs compile-watch wrappers actually
+registered in the source (watch_jit call sites), so the registry
+cannot drift from the real jit surface.
+
+Named checks:
+    no_scatter        zero scatter ops in the lowered module
+    no_f64            no f64 type anywhere ((?<!d)f64 — "df64" names)
+    no_host_callback  no host-callback custom calls
+    donation_honored  at least one donated operand (tf.aliasing_output)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from . import Finding
+
+RULE = "hlo-contract"
+
+# -- text predicates (the ONE definition of the test regexes) ---------
+
+# "f64" with a (?<!d) guard: the substring also occurs inside the
+# NAME df64 in module metadata (test_doubleword's hard-won pin)
+F64_RE = re.compile(r"(?<!d)f64")
+_CALLBACK_TOKENS = ("xla_python_cpu_callback", "xla_ffi_python",
+                    "io_callback", "pure_callback", "CustomCall")
+
+
+def scatter_count(hlo_text: str) -> int:
+    """Occurrences of scatter ops in a lowered/compiled module text."""
+    return hlo_text.lower().count("scatter")
+
+
+def has_f64(hlo_text: str) -> bool:
+    """True when any f64 type appears (df64 NAMES excluded)."""
+    return bool(F64_RE.search(hlo_text))
+
+
+def has_host_callback(hlo_text: str) -> bool:
+    return any(tok in hlo_text for tok in _CALLBACK_TOKENS)
+
+
+def donation_present(hlo_text: str) -> bool:
+    """True when the lowered module carries donated-operand aliasing
+    (jax 0.4.x lowers donate_argnums as tf.aliasing_output attrs;
+    compiled HLO spells it input_output_alias)."""
+    return ("tf.aliasing_output" in hlo_text
+            or "jax.buffer_donor" in hlo_text
+            or "input_output_alias" in hlo_text)
+
+
+CHECKS = {
+    "no_scatter": lambda t: (scatter_count(t) == 0,
+                             f"{scatter_count(t)} scatter op(s)"),
+    "no_f64": lambda t: (not has_f64(t), "f64 type present"),
+    "no_host_callback": lambda t: (not has_host_callback(t),
+                                   "host callback present"),
+    "donation_honored": lambda t: (donation_present(t),
+                                   "no donated-operand aliasing"),
+}
+
+# package modules that declare HLO_CONTRACTS (kept explicit: walking
+# every module would import the world; adding a registry module is a
+# one-line change here)
+CONTRACT_MODULES = (
+    "superlu_dist_tpu.ops.trisolve",
+    "superlu_dist_tpu.ops.spmv",
+    "superlu_dist_tpu.precision.doubleword",
+)
+
+
+def iter_contracts(modules=CONTRACT_MODULES) -> list[dict]:
+    import importlib
+    out = []
+    for modname in modules:
+        mod = importlib.import_module(modname)
+        for entry in getattr(mod, "HLO_CONTRACTS", ()):
+            e = dict(entry)
+            e.setdefault("module", modname)
+            out.append(e)
+    names = [e["name"] for e in out]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"duplicate HLO contract names: {dupes}")
+    return out
+
+
+def registered_phases(root: str) -> set[str]:
+    """Phase labels of every obs.watch_jit call site in the package —
+    the compile-watch wrapper surface contract entries must name."""
+    labels = set()
+    pat = re.compile(r"watch_jit\(\s*[\"']([a-z0-9_]+)[\"']")
+    pkg = os.path.join(root, "superlu_dist_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in filenames:
+            if f.endswith(".py"):
+                labels |= set(pat.findall(
+                    open(os.path.join(dirpath, f)).read()))
+    return labels
+
+
+class _EnvPatch:
+    def __init__(self, env: dict):
+        self.env = env or {}
+        self.saved: dict = {}
+
+    def __enter__(self):
+        for k, v in self.env.items():
+            self.saved[k] = os.environ.get(k)
+            os.environ[k] = v
+
+    def __exit__(self, *exc):
+        for k, old in self.saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def lowered_text(entry: dict) -> str:
+    """Lower a contract entry's program at its representative
+    signature and return the module text."""
+    with _EnvPatch(entry.get("env")):
+        fn, args, kwargs = entry["build"]()
+        return fn.lower(*args, **(kwargs or {})).as_text()
+
+
+def check_entry(entry: dict) -> list[Finding]:
+    """Findings for one registry entry (empty = contract holds)."""
+    name = entry["name"]
+    path = entry.get("module", "?").replace(".", "/") + ".py"
+    out = []
+    try:
+        if "check" in entry:
+            with _EnvPatch(entry.get("env")):
+                ok, msg = entry["check"]()
+            if not ok:
+                out.append(Finding(RULE, path, 0,
+                                   f"contract {name}: {msg}",
+                                   detail=f"{name}:custom"))
+            return out
+        txt = lowered_text(entry)
+    except Exception as e:          # noqa: BLE001 — report, not crash
+        out.append(Finding(RULE, path, 0,
+                           f"contract {name}: build/lower failed: "
+                           f"{type(e).__name__}: {e}",
+                           detail=f"{name}:build"))
+        return out
+    for cname in entry.get("contracts", ()):
+        chk = CHECKS.get(cname)
+        if chk is None:
+            out.append(Finding(RULE, path, 0,
+                               f"contract {name}: unknown check "
+                               f"{cname!r}",
+                               detail=f"{name}:{cname}:unknown"))
+            continue
+        ok, msg = chk(txt)
+        if not ok:
+            out.append(Finding(
+                RULE, path, 0,
+                f"contract {name} violated ({cname}): {msg}"
+                + (f" — {entry['note']}" if entry.get("note") else ""),
+                detail=f"{name}:{cname}"))
+    return out
+
+
+def check_all(root: str | None = None) -> list[Finding]:
+    from . import repo_root
+    root = root or repo_root()
+    findings: list[Finding] = []
+    try:
+        entries = iter_contracts()
+    except Exception as e:          # noqa: BLE001 — import-time failure
+        return [Finding(RULE, "tools/slulint/contracts.py", 0,
+                        f"contract registry import failed: {e}",
+                        detail="registry:import")]
+    phases = registered_phases(root)
+    for entry in entries:
+        ph = entry.get("phase")
+        if ph and ph not in phases:
+            findings.append(Finding(
+                RULE, entry.get("module", "?").replace(".", "/")
+                + ".py", 0,
+                f"contract {entry['name']} names phase {ph!r} but no "
+                "obs.watch_jit call site registers it — the registry "
+                "drifted from the jit surface",
+                detail=f"{entry['name']}:phase"))
+        findings.extend(check_entry(entry))
+    return findings
+
+
+def assert_contract(name: str) -> None:
+    """One-line test assertion: raise AssertionError with the
+    violation text when the named registry contract fails — what the
+    former per-test HLO regex pins migrate to."""
+    entries = [e for e in iter_contracts() if e["name"] == name]
+    assert entries, f"no HLO contract named {name!r} in the registry"
+    findings = check_entry(entries[0])
+    assert not findings, "; ".join(f.msg for f in findings)

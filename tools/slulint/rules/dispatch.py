@@ -1,0 +1,102 @@
+"""`static-kwarg`: static_argnames jits called with keywords.
+
+PR 7 measured it: calling a `jit(..., static_argnames=...)` function
+with those arguments as KEYWORDS drops jax to the slow Python
+dispatch path — ~ms per call against a large-pytree signature, real
+money on an nrhs=1 solve hot path (ops/trisolve.py builds two
+positional jits instead, see `_solve_packed_fn`).  This rule flags
+keyword calls of intra-module static_argnames jits, EXCEPT when the
+parameter is keyword-only in the wrapped def (`*, metas, trans`):
+that shape cannot be called positionally, so it documents a
+deliberate trade (per-segment dispatch amortized over a whole
+segment's work) rather than an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+RULE = "static-kwarg"
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _static_names(call: ast.Call) -> frozenset | None:
+    """static_argnames of a jax.jit(...) / partial(jax.jit, ...) call
+    expression, or None when it isn't one."""
+    f = _dotted(call.func)
+    inner = None
+    if f and f[-1] == "jit":
+        inner = call
+    elif f and f[-1] == "partial" and call.args \
+            and _dotted(call.args[0]) and _dotted(call.args[0])[-1] == "jit":
+        inner = call
+    if inner is None:
+        return None
+    for kw in inner.keywords:
+        if kw.arg == "static_argnames":
+            names = []
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names = [e.value for e in v.elts
+                         if isinstance(e, ast.Constant)]
+            return frozenset(names)
+    return frozenset()      # a jit with no static_argnames
+
+
+def check(tree, src, path, ann):
+    out = []
+    # name -> (static names, keyword-only params of the def)
+    jits: dict[str, tuple[frozenset, frozenset]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            kwonly = frozenset(a.arg for a in node.args.kwonlyargs)
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    names = _static_names(dec)
+                    if names:
+                        jits[node.name] = (names, kwonly)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            names = _static_names(node.value)
+            if names:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jits[tgt.id] = (names, frozenset())
+
+    if not jits:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Name):
+            continue
+        entry = jits.get(node.func.id)
+        if entry is None:
+            continue
+        statics, kwonly = entry
+        bad = [kw.arg for kw in node.keywords
+               if kw.arg in statics and kw.arg not in kwonly]
+        if bad:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"{node.func.id}() called with static_argnames "
+                f"keyword(s) {bad} — keyword calls on a "
+                "static_argnames jit take the slow dispatch path; "
+                "pass positionally or build per-value jits",
+                detail=f"{node.func.id}:{','.join(sorted(bad))}"))
+    return out

@@ -1,0 +1,119 @@
+"""`unused-import` + `mutable-default`: the generic-hygiene rules.
+
+unused-import is the pyflakes-class check slulint carries natively so
+the gate works in environments without ruff/pyflakes installed (this
+container bakes neither); when ruff IS available, __main__ runs it
+with the committed ruff.toml as an additional pass.  Conservative by
+design: only module-level and function-level `import x` / `from y
+import x` whose bound name is never referenced anywhere in the file
+(as a load, an attribute root, a decorator, or an `__all__` string)
+is flagged.  `__init__.py` files are skipped — re-export IS their
+use.  `# noqa` on the import line also suppresses (ruff
+compatibility).
+
+mutable-default flags `def f(x=[])` / `={}` / `=set()` — the shared-
+mutable-state aliasing class.  Pytree-carrying signatures make it
+worse here: a mutated default list of arrays aliases across calls AND
+across jit signatures.  The legal spelling is `None` + a body check.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+RULE_IMPORT = "unused-import"
+RULE_DEFAULT = "mutable-default"
+
+
+def check(tree, src, path, ann):
+    out = []
+    out.extend(_mutable_defaults(tree, path))
+    if not path.endswith("__init__.py"):
+        out.extend(_unused_imports(tree, src, path))
+    return out
+
+
+def _mutable_defaults(tree, path):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        a = node.args
+        for d in list(a.defaults) + [x for x in a.kw_defaults if x]:
+            bad = None
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                bad = type(d).__name__.lower() + " literal"
+            elif isinstance(d, ast.Call) \
+                    and isinstance(d.func, ast.Name) \
+                    and d.func.id in ("list", "dict", "set",
+                                      "bytearray"):
+                bad = f"{d.func.id}()"
+            if bad:
+                name = getattr(node, "name", "<lambda>")
+                out.append(Finding(
+                    RULE_DEFAULT, path, d.lineno,
+                    f"mutable default ({bad}) in {name!r} — one "
+                    "object shared across every call; default to "
+                    "None and build in the body",
+                    detail=f"{name}:{bad}"))
+    return out
+
+
+def _unused_imports(tree, src, path):
+    # bound name -> (line, display)
+    imports: dict[str, tuple[int, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                name = al.asname or al.name.split(".")[0]
+                imports.setdefault(name, (node.lineno, al.name))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for al in node.names:
+                if al.name == "*":
+                    continue
+                name = al.asname or al.name
+                imports.setdefault(
+                    name, (node.lineno,
+                           f"{node.module or ''}.{al.name}"))
+    if not imports:
+        return []
+
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and not isinstance(node.ctx,
+                                                         ast.Store):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # identifier-shaped strings count as use: __all__ entries and
+    # string annotations under `from __future__ import annotations`
+    # (prose docstrings don't match — they contain spaces)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         str):
+            v = node.value
+            if v.replace(".", "").replace("_", "").isalnum():
+                used.add(v.split(".")[0].split("[")[0])
+
+    lines = src.splitlines()
+    out = []
+    for name, (lineno, display) in sorted(imports.items()):
+        if name in used:
+            continue
+        line_txt = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in line_txt:
+            continue
+        out.append(Finding(
+            RULE_IMPORT, path, lineno,
+            f"imported name {name!r} ({display}) is never used",
+            detail=name))
+    return out

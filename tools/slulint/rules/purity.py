@@ -1,0 +1,156 @@
+"""`host-call-in-jit`: host-only calls inside traced code.
+
+A function that jax traces (jit-decorated, passed to jax.jit, or a
+control-flow body handed to lax.fori_loop/while_loop/scan/cond/
+switch or jax.vmap/pmap) executes its Python body ONCE at trace time:
+`time.time()` stamps the compile, not the run; `np.random` draws a
+constant baked into the program; `print` fires once per signature;
+`os.environ` reads freeze a knob into the compiled artifact.  All are
+almost always bugs in traced code — the honest forms are jax.random,
+jax.debug.print, and passing values as operands.
+
+Detection is intra-module and syntactic: decorated defs
+(@jax.jit/@jit/@partial(jax.jit, ...)), local functions whose NAME is
+passed to a tracing entry point, and lambdas passed inline.  Nested
+defs inside a traced function are treated as traced too (they run
+under the same trace unless explicitly escaped — annotate
+`# slulint: ok host-call-in-jit` for io_callback-style escapes).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+RULE = "host-call-in-jit"
+
+# module-attr call roots that are host-only inside a trace
+_BANNED_ATTR = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("time", "sleep"), ("time", "process_time"),
+    ("os", "getenv"), ("os", "urandom"),
+}
+_BANNED_PREFIX = (
+    ("np", "random"), ("numpy", "random"), ("random",),
+    ("os", "environ"),
+)
+_BANNED_NAME = {"print", "input", "open", "breakpoint"}
+# the flags.py env gateway is the package's ONLY legal env-read form,
+# so it must be banned inside traces by METHOD NAME regardless of how
+# the module was imported (flags/_flags/env_str directly) — else the
+# very migration that removed os.environ would hide the trace-time-
+# freeze bug class from this rule
+_BANNED_TAIL = {"env_opt", "env_str", "env_int", "env_float"}
+
+# callables whose function-valued arguments are traced: name -> arg
+# positions holding traced callables (None = all positional args)
+_TRACING_CALLS = {
+    "jit": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2, 3),
+    "switch": None,
+    "vmap": (0,),
+    "pmap": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "custom_jvp": (0,),
+    "custom_vjp": (0,),
+    "shard_map": (0,),
+}
+
+
+def _dotted(node: ast.AST) -> tuple:
+    """('jax', 'jit') for jax.jit, ('f',) for f; () when dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    d = _dotted(dec)
+    if d and d[-1] == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        f = _dotted(dec.func)
+        if f and f[-1] == "jit":
+            return True
+        # functools.partial(jax.jit, ...)
+        if f and f[-1] == "partial" and dec.args:
+            inner = _dotted(dec.args[0])
+            if inner and inner[-1] == "jit":
+                return True
+    return False
+
+
+def _banned(call: ast.Call) -> str | None:
+    d = _dotted(call.func)
+    if not d:
+        return None
+    if len(d) == 1 and d[0] in _BANNED_NAME:
+        return d[0]
+    if d[-1] in _BANNED_TAIL:
+        return ".".join(d)
+    if len(d) == 2 and (d[0], d[1]) in _BANNED_ATTR:
+        return ".".join(d)
+    # np.random.<fn>(), random.<fn>(), os.environ.get() — prefix
+    # families where anything below the prefix is host-only
+    for pref in _BANNED_PREFIX:
+        if len(d) > len(pref) and d[:len(pref)] == pref:
+            return ".".join(d)
+    return None
+
+
+def check(tree, src, path, ann):
+    out = []
+
+    # 1. collect traced function names / nodes
+    traced_defs: list[ast.AST] = []
+    defs_by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced_defs.append(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d[-1] not in _TRACING_CALLS:
+            continue
+        positions = _TRACING_CALLS[d[-1]]
+        idxs = range(len(node.args)) if positions is None else positions
+        for i in idxs:
+            if i >= len(node.args):
+                continue
+            a = node.args[i]
+            if isinstance(a, ast.Lambda):
+                traced_defs.append(a)
+            elif isinstance(a, ast.Name) and a.id in defs_by_name:
+                traced_defs.append(defs_by_name[a.id])
+
+    # 2. flag banned calls inside traced bodies (nested defs included)
+    seen_ids = set()
+    for fn in traced_defs:
+        if id(fn) in seen_ids:
+            continue
+        seen_ids.add(id(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                what = _banned(node)
+                if what:
+                    fname = getattr(fn, "name", "<lambda>")
+                    out.append(Finding(
+                        RULE, path, node.lineno,
+                        f"host-only call {what}() inside traced "
+                        f"function {fname!r} — executes at TRACE time, "
+                        "not per run",
+                        detail=f"{fname}:{what}"))
+    return out

@@ -1,0 +1,90 @@
+"""`untyped-raise` + `bare-except`.
+
+serve/ and resilience/ own an explicit failure taxonomy
+(serve/errors.py): every way a request can fail is a named exception
+type, so the loadgen status taxonomy, the chaos gate's `all_typed`
+check and callers' blanket handlers can tell capacity pushback from
+deadline economics from contained faults.  Raising a generic builtin
+(RuntimeError, Exception, OSError...) there punches a hole in that
+contract — the chaos gate would count it as an escape.  Named
+domain exceptions defined in-scope (StoreCorrupt, ChaosError) are
+typed; precondition builtins (ValueError/TypeError/KeyError/
+NotImplementedError/AssertionError) signal caller bugs, not service
+outcomes, and stay legal.  Re-raises (`raise` / `raise e` of a caught
+name) are flow, not vocabulary.
+
+`bare-except` applies everywhere: an `except:` swallows
+KeyboardInterrupt and SystemExit; the narrowest honest form is
+`except Exception` (and even that wants a reason).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+RULE_RAISE = "untyped-raise"
+RULE_BARE = "bare-except"
+
+_GENERIC = {"Exception", "BaseException", "RuntimeError", "OSError",
+            "IOError", "SystemError", "EnvironmentError"}
+_PRECONDITION = {"ValueError", "TypeError", "KeyError", "IndexError",
+                 "NotImplementedError", "AssertionError",
+                 "StopIteration", "AttributeError"}
+
+
+def _serve_scope(path: str) -> bool:
+    parts = path.split("/")
+    return "serve" in parts or "resilience" in parts
+
+
+def check(tree, src, path, ann):
+    out = []
+    typed_scope = _serve_scope(path)
+    caught: set[str] = set()        # names bound by `except ... as e`
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                out.append(Finding(
+                    RULE_BARE, path, node.lineno,
+                    "bare `except:` swallows KeyboardInterrupt/"
+                    "SystemExit — name the exception class",
+                    detail=f"except@{_enclosing(tree, node)}"))
+            if node.name:
+                caught.add(node.name)
+    if not typed_scope:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            if exc.id in caught:
+                continue            # re-raise of a caught exception
+            name = exc.id
+        if name in _GENERIC:
+            out.append(Finding(
+                RULE_RAISE, path, node.lineno,
+                f"raise {name} in serve/resilience scope — use the "
+                "serve/errors.py taxonomy (or a named domain "
+                "exception) so failures stay typed end-to-end",
+                detail=f"{_enclosing(tree, node)}:{name}"))
+    return out
+
+
+def _enclosing(tree, node) -> str:
+    """Name of the innermost function/class containing `node` — the
+    line-stable fingerprint leg."""
+    best = ""
+    for parent in ast.walk(tree):
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            if (parent.lineno <= node.lineno
+                    <= max(getattr(parent, "end_lineno", parent.lineno),
+                           parent.lineno)):
+                best = parent.name   # innermost wins: walk is pre-order
+    return best or "<module>"

@@ -38,6 +38,19 @@ t0=$(date +%s)
 stamp() { echo "[$(date +%H:%M:%S) +$(( $(date +%s) - t0 ))s] $*" >> "$log"; }
 stamp "fire start (dryrun=${SLU_FIRE_DRYRUN:-0})"
 
+# 0. slulint fail-fast (static gate, no jax import): a round whose
+#    code violates the HLO/lock/lint contracts must not spend the
+#    tunnel window measuring it — the full contracts pass (which
+#    lowers programs) runs in tier-1; the fast pass here is AST +
+#    lock auditor + flag audit against SLULINT_BASELINE.json.
+PYTHONPATH=$repo timeout 240 python -m tools.slulint --no-contracts >> "$log" 2>&1
+rc=$?
+stamp "slulint rc=$rc"
+if [ $rc -ne 0 ]; then
+  stamp "slulint gate FAILED — aborting the fire plan (fix or re-baseline with --update)"
+  exit $rc
+fi
+
 # 1. BENCH, primary config only — the <5-min-budget artifact.  The
 #    watcher just probed, so skip bench's own probe ladder; staged
 #    dispatch stays off (200 ms tunnel RPC x groups).  Write to a temp
