@@ -777,6 +777,17 @@ def main():
                          "tools", "serve_bench.py"),
             run_name="__main__")
         return
+    if "--fleet" in sys.argv[1:]:
+        # fleet drill (tools/fleet_drill.py): >=3 replica processes
+        # on one shared store, chaos load, kill -9 mid-load — gates
+        # zero lost/hung, warm takeover, exactly-one fleet-wide
+        # factorization per cold key; appends to FLEET.jsonl
+        import runpy
+        runpy.run_path(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "fleet_drill.py"),
+            run_name="__main__")
+        return
     if "--prec" in sys.argv[1:]:
         # mixed-precision A/B (ISSUE 5): fp32 factor + df64-pair IR
         # residual vs fp32 factor + native-f64 IR residual, one JSON

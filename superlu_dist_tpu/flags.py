@@ -81,6 +81,17 @@ FLAGS: dict[str, str] = {
     "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds); deterministic per-site seeded streams; every site is one pointer check when unset",
     "SLU_CHAOS_SEED": "chaos RNG seed (default 0): same spec+seed replays the identical failure sequence",
     "SLU_CHAOS_OUT": "serve_bench --chaos record path (default CHAOS.jsonl)",
+    # --- fleet coordination (fleet/, serve/, tools/fleet_drill.py) ---
+    "SLU_FLEET": "1 = fleet-wide single-flight over the shared factor store (fleet/lease.py): a cold key elects ONE leader across every replica process sharing SLU_FT_STORE via an O_EXCL lease file; followers poll-with-backoff and adopt the published entry; a dead leader's expired lease is stolen.  Off = the in-process single-flight only",
+    "SLU_FLEET_TTL_S": "fleet lease TTL override in seconds (0/unset = factor-cost-scaled default: SLU_FLEET_TTL_SCALE x the measured t_factor_s from SOLVE_LATENCY.jsonl, clamped to [10, 1800] s) — the bound on how long a dead leader blocks a key before its lease is stolen",
+    "SLU_FLEET_TTL_SCALE": "multiplier on the measured factorization cost when sizing the default lease TTL (default 2.0: a lease outlives the factorization it guards with 2x headroom)",
+    "SLU_FLEET_POLL_S": "fleet follower poll interval seconds (default 0.05), growing 1.5x per round to a 1 s cap — the cadence followers re-probe the store for the leader's published entry",
+    "SLU_FLEET_VNODES": "virtual nodes per replica on the consistent-hash ring (default 64): smooths per-replica keyspace shares; membership changes still move only the joined/left replica's arc",
+    "SLU_FLEET_REPLICAS": "fleet drill replica-process count (default 3; the drill requires >=3 so a kill leaves a pool, not a pair)",
+    "SLU_FLEET_REQUESTS": "fleet drill chaos-load request count (default 48)",
+    "SLU_FLEET_K": "fleet drill grid size k (3D Laplacian, n=k^3; default 4)",
+    "SLU_FLEET_OUT": "fleet drill record path (default FLEET.jsonl)",
+    "SLU_FLEET_KILL_AFTER": "fraction of the drill's load phase served before the victim replica is kill -9'd (default 0.33)",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---

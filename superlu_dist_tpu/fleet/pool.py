@@ -1,0 +1,193 @@
+"""Replica pool: consistent-hash routing + typed failover.
+
+The client-side composition of the fleet pieces: `solve(a, b)` hashes
+the request's factor key onto the ring (router.py), sends it to the
+key's HOME replica, and — when the home is down or answers with a
+typed factor-unavailability error — walks the ring's failover chain
+instead of surfacing the failure.  The last line of defense is not
+here but inside each replica: a routed-to replica whose key is
+circuit-broken serves through its stale-factor DEGRADED path
+(serve/service.py, PR 5), so the pool's contract to callers is the
+serve layer's, held fleet-wide:
+
+    a successful solve, a DegradedResult-stamped solve, or a TYPED
+    ServeError — never an untyped error, never a lost request.
+
+Failover taxonomy (what reroutes vs what doesn't):
+
+  * ServeRejected / DeadlineExceeded            -> RAISED: these are
+    economics (capacity pushback, the caller's own clock), and
+    rerouting would turn honest pushback into load amplification
+  * down replica (mark_down / health callback)  -> next in chain
+  * any OTHER typed ServeError (FactorPoisoned,
+    FactorMissError, FlusherDead, closed
+    service, ...)                               -> next in chain: the
+    replica cannot serve this key NOW, a sibling warm from the
+    shared store plausibly can — and a failure deterministic across
+    replicas surfaces after one walk of the chain, still typed
+  * connection death (ConnectionError / EOFError
+    / OSError)                                  -> mark down + next.
+
+Anything else (ValueError on a bad-shape rhs, a genuine bug) is a
+caller/solver fault that would repeat identically at every replica:
+it PROPAGATES rather than poisoning the pool's down-set.
+
+Every hop stamps `route.failover` on the pool-level flight record
+(the request's fleet-scope rid; each replica's own serve layer keeps
+its per-replica record) — the drill's traceability gate reads these.
+
+This pool fronts IN-PROCESS replicas (SolveService instances or any
+`solve(a, b, options=, deadline_s=)` callable-shaped endpoint, e.g.
+the drill's socket client stubs).  Cross-process membership/death is
+the caller's to signal via `mark_down` — in the drill, a connection
+reset IS the death signal.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..obs import flight
+from ..options import Options
+from ..serve.errors import (DeadlineExceeded, DegradedResult,
+                            ServeError, ServeRejected)
+from ..serve.factor_cache import CacheKey, matrix_key
+from .router import HashRing
+
+
+def _route_key(key: CacheKey) -> str:
+    """Ring coordinate for a cache key: the PATTERN leg (plus the
+    repr'd options — process-stable, unlike hash() under
+    PYTHONHASHSEED) — all values-variants of one pattern share a
+    home, so the pattern-tier plan reuse and stale-factor degraded
+    cover both stay local to one replica."""
+    return f"{key.pattern}|{key.options!r}"
+
+
+class ReplicaPool:
+    """Route-and-failover front over named replica endpoints."""
+
+    def __init__(self, replicas: dict, vnodes: int | None = None,
+                 metrics=None) -> None:
+        if not replicas:
+            raise ValueError("ReplicaPool needs at least one replica")
+        self.replicas = dict(replicas)
+        self.ring = HashRing(self.replicas, vnodes=vnodes)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._down: set[str] = set()
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    # -- membership -----------------------------------------------------
+
+    def mark_down(self, name: str) -> None:
+        """Record a replica as dead (connection reset, kill signal,
+        failed health check).  Routing skips it; the ring itself is
+        unchanged, so its keys fail over along their normal chain and
+        come HOME again on mark_up — no keyspace reshuffle."""
+        with self._lock:
+            self._down.add(name)
+        self._inc("fleet.replica_down")
+
+    def mark_up(self, name: str) -> None:
+        with self._lock:
+            self._down.discard(name)
+
+    def is_down(self, name: str) -> bool:
+        with self._lock:
+            return name in self._down
+
+    def live(self) -> list[str]:
+        with self._lock:
+            return [r for r in self.ring.replicas
+                    if r not in self._down]
+
+    # -- routing --------------------------------------------------------
+
+    def route_for(self, a, options: Options | None = None) -> list:
+        """The ordered replica chain a request for `a` walks."""
+        key = a if isinstance(a, CacheKey) \
+            else matrix_key(a, options or Options())
+        return self.ring.route(_route_key(key))
+
+    # -- the request path -----------------------------------------------
+
+    def solve(self, a, b, options: Options | None = None,
+              deadline_s: float | None = None):
+        """Route `a` to its home replica; fail over along the ring on
+        death or typed factor unavailability.  Returns x (possibly
+        DegradedResult-stamped by the serving replica)."""
+        t0 = time.monotonic()
+        order = self.route_for(a, options)
+        rec = flight.start(scope="fleet", home=order[0])
+        last_err: BaseException | None = None
+        try:
+            for i, name in enumerate(order):
+                if self.is_down(name):
+                    self._hop(rec, name, "down", i)
+                    continue
+                endpoint = self.replicas[name]
+                remaining = None
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        raise DeadlineExceeded(
+                            "deadline passed walking the failover "
+                            "chain")
+                try:
+                    x = endpoint.solve(a, b, options=options,
+                                       deadline_s=remaining)
+                except (ServeRejected, DeadlineExceeded):
+                    raise      # economics: reroute would amplify load
+                except ServeError as e:
+                    # typed unavailability (FactorPoisoned, miss,
+                    # FlusherDead, closed, ...): the replica cannot
+                    # serve this key now; a store-warm sibling can
+                    last_err = e
+                    self._hop(rec, name, type(e).__name__, i)
+                    continue
+                except (ConnectionError, EOFError, OSError) as e:
+                    # an endpoint that died mid-call (the drill's
+                    # connection reset): the replica is dead — mark
+                    # it down and reroute, so one dead process costs
+                    # one hop, not an error per subsequent request.
+                    # ONLY connection-class faults mean death: a
+                    # caller bug (bad-shape rhs raising ValueError)
+                    # would repeat identically at every replica, and
+                    # marking the chain down for it would poison the
+                    # pool for all later healthy requests — it
+                    # propagates instead
+                    last_err = e
+                    self.mark_down(name)
+                    self._hop(rec, name,
+                              f"dead:{type(e).__name__}", i)
+                    continue
+                if rec is not None:
+                    rec.annotate(served_by=name, hops=i)
+                    rec.finish("degraded"
+                               if isinstance(x, DegradedResult)
+                               else "ok")
+                self._inc("fleet.served")
+                return x
+            err = ServeError(
+                f"no replica could serve (chain {order}; last: "
+                f"{type(last_err).__name__ if last_err else 'none'}: "
+                f"{last_err})")
+            if last_err is not None:
+                raise err from last_err
+            raise err
+        except BaseException as e:
+            if rec is not None and not rec._done:
+                from ..serve.service import SolveService
+                rec.finish(SolveService._outcome_of(e), error=e)
+            raise
+
+    def _hop(self, rec, name: str, reason: str, position: int) -> None:
+        self._inc("fleet.route_failover")
+        if rec is not None:
+            rec.event("route.failover", frm=name, reason=reason,
+                      position=position)

@@ -1,0 +1,99 @@
+"""Consistent-hash factor-key routing.
+
+Residency should be deliberate.  Without routing, which replica holds
+a pattern's factors is an accident of which replica a load balancer
+happened to hand the first request — warm traffic then scatters
+across the pool and every replica slowly accretes every key (N×
+memory for the same working set).  A consistent-hash ring fixes both:
+each key has a HOME replica every client computes identically (warm
+traffic lands on resident factors), and membership changes move only
+the keys adjacent to the joined/left replica — a replica death
+reassigns its arc, not the whole keyspace (the classic Karger
+property; the HPL-exascale discipline of never redoing work a
+surviving owner already holds).
+
+`route(key)` returns the full ORDERED preference list, not one
+target: position 0 is the home, positions 1+ are the failover chain
+the pool walks when the home is down or circuit-broken
+(fleet/pool.py).  The hash is sha256 — process-independent
+(str.__hash__ is PYTHONHASHSEED-randomized and would route every
+replica's traffic differently), and the same stable-hash discipline
+chaos.py already uses for its seeded streams.
+
+`vnodes` virtual nodes per replica (SLU_FLEET_VNODES, default 64)
+smooth the arc sizes: at 3 replicas × 64 vnodes the max/min keyspace
+share imbalance stays within ~2× (pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+from .. import flags
+
+
+def _point(label: str) -> int:
+    """Stable 64-bit ring coordinate for a label."""
+    return int.from_bytes(
+        hashlib.sha256(label.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over named replicas.
+
+    Immutable by convention: membership changes build a new ring
+    (`with_replicas`) — routing must be a pure function of (members,
+    key) so every client, and every test, computes the same homes.
+    """
+
+    def __init__(self, replicas, vnodes: int | None = None) -> None:
+        self.replicas = tuple(sorted(set(replicas)))
+        if not self.replicas:
+            raise ValueError("HashRing needs at least one replica")
+        self.vnodes = int(vnodes) if vnodes \
+            else flags.env_int("SLU_FLEET_VNODES", 64)
+        points: list[tuple[int, str]] = []
+        for r in self.replicas:
+            for v in range(self.vnodes):
+                points.append((_point(f"{r}#{v}"), r))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [r for _, r in points]
+
+    def with_replicas(self, replicas) -> "HashRing":
+        return HashRing(replicas, vnodes=self.vnodes)
+
+    def home(self, key: str) -> str:
+        """The key's home replica (route(key)[0], without building
+        the full list)."""
+        i = bisect.bisect_right(self._points, _point(key)) \
+            % len(self._points)
+        return self._owners[i]
+
+    def route(self, key: str) -> list[str]:
+        """Ordered preference list: the home first, then each further
+        DISTINCT replica in ring order — the failover chain.  Always
+        length == len(replicas)."""
+        i = bisect.bisect_right(self._points, _point(key)) \
+            % len(self._points)
+        order: list[str] = []
+        seen = set()
+        n = len(self._points)
+        for step in range(n):
+            r = self._owners[(i + step) % n]
+            if r not in seen:
+                seen.add(r)
+                order.append(r)
+                if len(order) == len(self.replicas):
+                    break
+        return order
+
+    def shares(self, samples: int = 4096) -> dict[str, float]:
+        """Keyspace share per replica, estimated over `samples`
+        synthetic keys — the balance probe the vnode count is sized
+        against."""
+        counts = {r: 0 for r in self.replicas}
+        for i in range(samples):
+            counts[self.home(f"sample-key-{i}")] += 1
+        return {r: c / samples for r, c in sorted(counts.items())}

@@ -44,14 +44,43 @@ the JSONL sink serialize on the recorder lock.
 
 from __future__ import annotations
 
+import binascii
 import collections
 import itertools
 import json
+import os
 import threading
 import time
 
 from .. import flags
 from . import tracer as _tracer
+
+# --------------------------------------------------------------------
+# replica identity: pid + boot nonce
+# --------------------------------------------------------------------
+# rids are allocated by a per-process lock-free counter, so two
+# REPLICAS of one service emit colliding rids into any shared sink
+# (a fleet SLU_FLIGHT_JSONL, the drill's merged trace).  Every record
+# therefore carries a replica id — pid plus a boot nonce, because
+# pids recycle across restarts and a restarted replica's rid 1 must
+# not alias its predecessor's.  (replica, rid) is the fleet-unique
+# request id; tools/trace_export.py groups per-replica on it.
+
+_REPLICA_ID: str | None = None
+_replica_lock = threading.Lock()
+
+
+def replica_id() -> str:
+    """This process's replica id, minted once per boot:
+    '<pid-hex>-<nonce>'.  Stable for the process lifetime; distinct
+    across restarts even when the pid recycles."""
+    global _REPLICA_ID
+    if _REPLICA_ID is None:
+        with _replica_lock:
+            if _REPLICA_ID is None:
+                nonce = binascii.hexlify(os.urandom(3)).decode()
+                _REPLICA_ID = f"{os.getpid():x}-{nonce}"
+    return _REPLICA_ID
 
 # outcome -> the pipeline stage that failed it (the coarse map; the
 # record's event list is the fine-grained story).  "ok" has no
@@ -111,7 +140,8 @@ class FlightRecord:
                               e2e_s=e2e_s)
 
     def to_dict(self) -> dict:
-        return {"rid": self.rid, "t0_us": self.t0_us,
+        return {"rid": self.rid, "replica": replica_id(),
+                "t0_us": self.t0_us,
                 "e2e_us": self.e2e_us, "outcome": self.outcome,
                 "error": self.error,
                 "failed_stage": self.failed_stage,
@@ -254,6 +284,7 @@ class FlightRecorder:
         with self._lock:
             recs = [r.to_dict() for r in self._ring]
             return {"enabled": True,
+                    "replica": replica_id(),
                     "started": self.started,
                     "finished": self.finished,
                     "retained": self.retained,

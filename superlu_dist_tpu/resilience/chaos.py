@@ -17,6 +17,15 @@ Spec grammar (`SLU_CHAOS` or `install(spec)`):
         store_flip=1              every store read gets one bit flipped
         flusher_raise=0.05        5% of flusher batches kill the flusher
         latency=0.2:0.005         20% of dispatches sleep 5 ms
+        store_latency=0.3:0.02    30% of store reads/writes sleep 20 ms
+                                  (a slow shared warm tier / object store)
+        lease_steal=0.1           10% of fleet lease-freshness checks
+                                  treat a FRESH lease as expired — forces
+                                  the steal path without killing a leader
+        replica_kill=1:2.0        arm a self-SIGKILL 2 s after the site
+                                  first fires (DRILL-ONLY: the process
+                                  dies the way `kill -9` kills it — no
+                                  handlers, no cleanup)
 
 Determinism: each site owns a `random.Random` seeded from
 (`SLU_CHAOS_SEED`, site name), so the same spec+seed replays the same
@@ -38,7 +47,7 @@ import time
 from .. import flags
 
 SITES = ("factor_raise", "factor_nan", "store_flip", "flusher_raise",
-         "latency")
+         "latency", "store_latency", "lease_steal", "replica_kill")
 
 
 def _stable_seed(seed: int, *legs) -> int:
@@ -161,6 +170,33 @@ def maybe_flip_bit(site: str, data: bytes) -> bytes:
     out = bytearray(data)
     out[i] ^= 1 << rng.randrange(8)
     return bytes(out)
+
+
+def maybe_replica_kill(site: str = "replica_kill") -> bool:
+    """DRILL-ONLY self-`kill -9`: when `site` fires, arm a daemon
+    timer that SIGKILLs THIS process after the site's param seconds
+    (default: immediately).  SIGKILL is deliberate — no atexit, no
+    finally blocks, no flusher drain: the fleet drill needs the
+    ugliest replica death there is, the one the lease TTL and the
+    survivors' failover must absorb.  Returns whether the kill was
+    armed (the drill logs it; nothing sane ever checks the return
+    after the delay).  One pointer check when chaos is off; inert
+    unless the spec names the site."""
+    p = _POLICY
+    if p is None or not p.should(site):
+        return False
+    import os
+    import signal
+    delay = p.param(site, 0.0)
+
+    def _die() -> None:
+        if delay > 0:
+            time.sleep(delay)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    threading.Thread(target=_die, name="chaos-replica-kill",
+                     daemon=True).start()
+    return True
 
 
 def maybe_poison_factors(site: str, lu) -> None:

@@ -28,6 +28,22 @@ Quarantine renames the file to `<entry>.quarantined` — the evidence
 survives for forensics, the load path never sees it again, and the
 next factorization's write-through replaces it.
 
+Multi-writer sharing (fleet/).  One store directory may be mounted by
+N replica PROCESSES as a shared warm tier.  The discipline that makes
+that safe is already the single-process one, held cross-process:
+writes stage into per-process tmp files (utils/io.atomic_write_bytes
+carries the writer's pid in the tmp name on top of mkstemp's O_EXCL
+uniqueness) and land by atomic rename, so two replicas racing a key
+never interleave bytes — the loser's complete entry simply replaces
+the winner's complete, byte-identical entry.  Reads treat EVERY
+concurrent-rename surprise as a miss, never an error: an entry
+quarantined or replaced by another replica between the existence
+check and the open is indistinguishable from absence, and the caller
+re-factors (or, under fleet single-flight, adopts the next published
+copy).  Cross-process single-flight itself — a cold key factoring
+once across the pool — is layered above by fleet/lease.py, keyed on
+the same entry names.
+
 What is stored: the plan (FactorPlan strips its jit caches via
 __getstate__), effective options, the original matrix (refinement
 residuals need A), and the factor arrays converted to host numpy.
@@ -170,6 +186,9 @@ class FactorStore:
         }
         blob = pickle.dumps(payload, protocol=4)
         framed = _MAGIC + hashlib.sha256(blob).digest() + blob
+        # chaos site: a slow shared warm tier (store_latency) — the
+        # fleet drill's stand-in for object-store write latency
+        chaos.maybe_sleep("store_latency")
         atomic_write_bytes(self.path_for(key), framed)
         self._inc("factor_store.saves")
         return self.path_for(key)
@@ -194,13 +213,15 @@ class FactorStore:
         vanished concurrently, or failed verification → quarantined).
         NOTHING is unpickled before the sha256 frame digest passes —
         pickle never sees unverified bytes."""
+        chaos.maybe_sleep("store_latency")
         try:
             with open(path, "rb") as f:
                 data = f.read()
         except OSError:
-            # quarantined/removed by a concurrent loader between the
-            # caller's existence check and our open: a miss, not an
-            # error — the caller re-factors
+            # quarantined/removed by a concurrent loader — possibly
+            # in ANOTHER REPLICA PROCESS — between the caller's
+            # existence check and our open: a miss, not an error —
+            # the caller re-factors
             self._inc("factor_store.misses")
             return None
         # chaos site: one flipped bit in the persisted entry — the

@@ -72,6 +72,33 @@ class DegradedResult(np.ndarray):
 
 
 @functools.lru_cache(maxsize=1)
+def factor_cost_hint_s() -> float | None:
+    """The latest measured cold-factorization wall (seconds) from
+    SOLVE_LATENCY.jsonl, or None when no record exists.  The numeric
+    twin of factor_cost_hint(): fleet/lease.py sizes its lease TTL
+    off this figure — a lease must outlive the factorization it
+    guards, and the measured trajectory is the only honest estimate
+    of that."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "SOLVE_LATENCY.jsonl")
+    last_t = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                t = rec.get("t_factor_s")
+                if t:
+                    last_t = float(t)
+    except OSError:
+        pass
+    return last_t
+
+
+@functools.lru_cache(maxsize=1)
 def factor_cost_hint() -> str:
     """Human-readable cold-factorization cost for error messages —
     centralized so the figure tracks the measured trajectory: reads

@@ -144,6 +144,7 @@ class FactorCache:
                  store=None,
                  breaker=None,
                  retry=None,
+                 fleet=None,
                  validate_factors: bool = True) -> None:
         self.capacity_bytes = capacity_bytes
         self.max_plans = max_plans
@@ -165,6 +166,28 @@ class FactorCache:
         # ServeConfig.
         self.breaker = breaker
         self.retry = retry
+        # fleet-wide single-flight (fleet/lease.py): with a shared
+        # store, a cold key elects ONE leader across all replica
+        # PROCESSES — followers adopt the published entry instead of
+        # stampeding the factorization.  True = REQUESTED (a
+        # coordinator over whatever store resolved, ServeConfig.fleet
+        # or explicit store alike); None defaults from SLU_FLEET=1;
+        # False is an EXPLICIT opt-out the env must not override
+        # (ServeConfig(fleet=False) under SLU_FLEET=1); explicit
+        # coordinators (tests) pass through.  Either way there is
+        # nothing to coordinate without a store.
+        if self.store is not None:
+            if fleet is True:
+                from ..fleet.lease import FleetCoordinator
+                fleet = FleetCoordinator(self.store.root,
+                                         metrics=self.metrics)
+            elif fleet is None:
+                from ..fleet.lease import coordinator_from_env
+                fleet = coordinator_from_env(self.store.root,
+                                             metrics=self.metrics)
+        self.fleet = fleet if not isinstance(fleet, bool) else None
+        if self.fleet is not None and self.fleet._metrics is None:
+            self.fleet._metrics = self.metrics
         # finite-validation gate: NaN/Inf factors raise FactorPoisoned
         # instead of entering the cache (GESP has no runtime pivoting
         # to catch them later — they would solve to silent garbage).
@@ -218,6 +241,11 @@ class FactorCache:
             "factor_retries": m.counter("factor_cache.factor_retries"),
             "breaker_rejected":
                 m.counter("factor_cache.breaker_rejected"),
+            # fleet tier (fleet/lease.py): cross-process single-flight
+            "fleet_adopted": m.counter("factor_cache.fleet_adopted"),
+            "fleet_leads": m.counter("fleet.lead"),
+            "fleet_waits": m.counter("fleet.waits"),
+            "fleet_steals": m.counter("fleet.steals"),
         }
 
     # -- core ----------------------------------------------------------
@@ -353,8 +381,10 @@ class FactorCache:
 
     def _acquire_factors(self, a, options, key) -> LUFactorization:
         """Factors for a confirmed miss: breaker gate → store
-        read-through → factorize (bounded retry, chaos sites, finite
-        validation) → store write-through."""
+        read-through → fleet single-flight (one leader across all
+        replica processes; followers adopt) → factorize (bounded
+        retry, chaos sites, finite validation) → store
+        write-through."""
         if self.breaker is not None and not self.breaker.allow(key):
             self.metrics.inc("factor_cache.breaker_rejected")
             raise FactorPoisoned(
@@ -362,19 +392,55 @@ class FactorCache:
                 "its factorization failed repeatedly; retry after "
                 "the cooldown")
         if self.store is not None:
-            lu = self.store.load(key)
+            lu = self._verified_store_load(key)
             if lu is not None:
-                if factors_finite(lu):
-                    self.metrics.inc("factor_cache.store_hits")
-                    if self.breaker is not None:
-                        # a verified store hit resolves the key (and
-                        # releases a half-open probe admitted above)
-                        self.breaker.record_success(key)
-                    return lu
-                # a verified-checksum entry with NaN factors means a
-                # pre-validation writer; quarantine and re-factor
-                self.store.quarantine(self.store.path_for(key),
-                                      reason="non-finite on load")
+                self.metrics.inc("factor_cache.store_hits")
+                if self.breaker is not None:
+                    # a verified store hit resolves the key (and
+                    # releases a half-open probe admitted above)
+                    self.breaker.record_success(key)
+                return lu
+        if self.fleet is not None and self.store is not None:
+            from ..resilience.store import entry_name
+            lu, role = self.fleet.factor_once(
+                entry_name(key),
+                # cheap existence prefilter: the verified (and
+                # counter-ticking) load only on presence, so a
+                # follower's poll loop doesn't inflate miss counters
+                probe=lambda: (self._verified_store_load(key)
+                               if self.store.contains(key) else None),
+                work=lambda: self._factor_locally(a, options, key))
+            if role == "adopt":
+                # another replica published; this one rode the wait.
+                # Same bookkeeping as a store hit: the key resolved
+                # without this process paying a factorization
+                self.metrics.inc("factor_cache.fleet_adopted")
+                self.metrics.inc("factor_cache.store_hits")
+                if self.breaker is not None:
+                    self.breaker.record_success(key)
+            return lu
+        return self._factor_locally(a, options, key)
+
+    def _verified_store_load(self, key):
+        """The ONE verified-store-read policy (shared by the
+        read-through and the fleet adopt probe, which must clear
+        identical checks): a finite handle, or None.  The store
+        itself verifies frame digest / checksum / layout and
+        quarantines corrupt entries; the extra finite gate here
+        covers pre-validation writers and pluggable store backends
+        whose load path may not re-validate."""
+        lu = self.store.load(key)
+        if lu is None or factors_finite(lu):
+            return lu
+        self.store.quarantine(self.store.path_for(key),
+                              reason="non-finite on load")
+        return None
+
+    def _factor_locally(self, a, options, key) -> LUFactorization:
+        """The in-process factorization path (pattern-tier plan
+        reuse, bounded retry, chaos sites, finite validation, store
+        write-through) — the fleet leader's `work`, and the whole
+        story when no coordinator is attached."""
         plan = None
         with self._lock:
             plan = self._plans.get(key.pattern_key)

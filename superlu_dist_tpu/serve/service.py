@@ -177,6 +177,13 @@ class ServeConfig:
     # matrix behind the berr guard and stamp the result DegradedResult
     # — instead of returning an outage
     degraded: bool = True
+    # --- fleet (fleet/) ---
+    # cross-process single-flight over the shared store (requires
+    # store_dir / SLU_FT_STORE): a cold key factors exactly once
+    # across every replica process sharing the store; followers
+    # adopt the published entry.  SLU_FLEET=1 flips the default.
+    fleet: bool = dataclasses.field(
+        default_factory=lambda: bool(flags.env_int("SLU_FLEET", 0)))
 
 
 class SolveService:
@@ -198,12 +205,16 @@ class SolveService:
             self.cache = cache
         else:
             cfg = self.config
+            store = (FactorStore(cfg.store_dir, metrics=self.metrics)
+                     if cfg.store_dir else None)
             self.cache = FactorCache(
                 capacity_bytes=cfg.capacity_bytes,
                 backend=cfg.backend, metrics=self.metrics,
-                store=(FactorStore(cfg.store_dir,
-                                   metrics=self.metrics)
-                       if cfg.store_dir else None),
+                store=store,
+                # True = coordinator over whatever store the cache
+                # resolves (store_dir OR SLU_FT_STORE); False = an
+                # explicit opt-out SLU_FLEET=1 must not override
+                fleet=bool(cfg.fleet),
                 breaker=(CircuitBreaker(
                     threshold=cfg.breaker_threshold,
                     cooldown_s=cfg.breaker_cooldown_s,

@@ -316,11 +316,17 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
     """Write `data` to `path` atomically: a reader (or a post-crash
     restart) sees either the old content or the complete new content,
     never a torn write.  Standard tmp-file + fsync + rename in the
-    destination directory (os.replace is atomic within a filesystem)."""
+    destination directory (os.replace is atomic within a filesystem).
+
+    The tmp name carries the writer's PID on top of mkstemp's own
+    O_EXCL random suffix: two REPLICA PROCESSES racing a write to one
+    shared-store path each stage into their own tmp file (never
+    interleaving bytes), and a crash's leftover tmp litter names the
+    process that leaked it."""
     import os as _os
     import tempfile as _tempfile
     d = _os.path.dirname(_os.path.abspath(path)) or "."
-    fd, tmp = _tempfile.mkstemp(prefix=".tmp-",
+    fd, tmp = _tempfile.mkstemp(prefix=f".tmp-{_os.getpid():x}-",
                                 suffix=_os.path.basename(path), dir=d)
     try:
         with _os.fdopen(fd, "wb") as f:

@@ -1,0 +1,563 @@
+"""fleet/: consistent-hash routing, cross-process single-flight
+(lease acquire/heartbeat/steal), the replica pool's typed failover
+into the degraded path, the new fleet chaos sites, and the
+multi-process store/write-race pins behind DESIGN.md §18."""
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.fleet import (FleetCoordinator, HashRing,
+                                    ReplicaPool)
+from superlu_dist_tpu.fleet.pool import _route_key
+from superlu_dist_tpu.models.gssvx import factorize
+from superlu_dist_tpu.obs import flight
+from superlu_dist_tpu.resilience import FactorStore, chaos
+from superlu_dist_tpu.resilience.store import entry_name
+from superlu_dist_tpu.serve import (DeadlineExceeded, DegradedResult,
+                                    FactorCache, ServeConfig,
+                                    SolveService, matrix_key)
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_globals():
+    """Chaos and the flight recorder are process-global; never leak
+    across tests."""
+    chaos.uninstall()
+    flight.configure(enabled=False)
+    yield
+    chaos.uninstall()
+    flight.configure(enabled=False)
+
+
+def _drift(a, factor):
+    return dataclasses.replace(a, data=a.data * factor)
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+# --------------------------------------------------------------------
+# consistent-hash ring
+# --------------------------------------------------------------------
+
+def test_ring_routing_is_deterministic_and_complete():
+    r1 = HashRing(["r0", "r1", "r2"], vnodes=64)
+    r2 = HashRing(["r2", "r0", "r1"], vnodes=64)   # order-insensitive
+    for key in ("a", "b", "pattern-xyz", "0123abc"):
+        assert r1.route(key) == r2.route(key)
+        order = r1.route(key)
+        # the full failover chain: every replica exactly once,
+        # home first
+        assert sorted(order) == ["r0", "r1", "r2"]
+        assert order[0] == r1.home(key)
+
+
+def test_ring_balance_within_bounds():
+    shares = HashRing([f"r{i}" for i in range(3)],
+                      vnodes=64).shares(4096)
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    assert max(shares.values()) / min(shares.values()) < 3.0
+
+
+def test_ring_membership_change_moves_only_the_lost_arc():
+    """The Karger property: removing one replica must not move keys
+    whose home survives — a replica death reassigns its arc only."""
+    full = HashRing(["r0", "r1", "r2"], vnodes=64)
+    smaller = full.with_replicas(["r0", "r1"])
+    for i in range(256):
+        key = f"k{i}"
+        if full.home(key) != "r2":
+            assert smaller.home(key) == full.home(key)
+        else:
+            assert smaller.home(key) in ("r0", "r1")
+
+
+# --------------------------------------------------------------------
+# lease protocol
+# --------------------------------------------------------------------
+
+def test_lease_acquire_is_exclusive_and_never_torn(tmp_path):
+    co = FleetCoordinator(str(tmp_path), ttl_s=30.0)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        if co.try_acquire("k"):
+            wins.append(1)
+
+    ts = [threading.Thread(target=race) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+    # the lease landed with complete content (hard-linked, not
+    # written in place): parseable, owned, fresh
+    lease = co.read_lease("k")
+    assert lease is not None and lease.replica == co.replica
+    assert not lease.expired()
+
+
+def test_lease_steal_is_exclusive(tmp_path):
+    co = FleetCoordinator(str(tmp_path), ttl_s=30.0)
+    assert co.try_acquire("k")
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        if co.try_steal("k"):
+            wins.append(1)
+
+    ts = [threading.Thread(target=race) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+    assert co.read_lease("k") is None        # buried, not replaced
+
+
+def test_lease_release_never_drops_anothers_lease(tmp_path):
+    mine = FleetCoordinator(str(tmp_path), ttl_s=30.0,
+                            replica="me")
+    theirs = FleetCoordinator(str(tmp_path), ttl_s=30.0,
+                              replica="them")
+    assert theirs.try_acquire("k")
+    mine.release("k")                        # not mine: must not unlink
+    lease = mine.read_lease("k")
+    assert lease is not None and lease.replica == "them"
+
+
+def _fleet_cache(tmp_path, delay_s=0.0, ttl_s=10.0, poll_s=0.01):
+    def slow(a, options, plan):
+        if delay_s:
+            time.sleep(delay_s)
+        return factorize(a, options, plan=plan, backend="host")
+
+    return FactorCache(
+        backend="host", store=FactorStore(str(tmp_path)),
+        fleet=FleetCoordinator(str(tmp_path), ttl_s=ttl_s,
+                               poll_s=poll_s),
+        factorize_fn=slow)
+
+
+def test_single_flight_across_cache_instances(tmp_path):
+    """Three 'replicas' (independent caches on one store) race one
+    cold key: exactly ONE factorization; the rest resolve without
+    paying one (fleet adopt if they arrived while the lease was
+    held, plain store read-through if the leader had already
+    published — which path each loser takes is scheduler timing, the
+    ZERO-extra-factorizations total is the contract)."""
+    a = laplacian_2d(5)
+    caches = [_fleet_cache(tmp_path, delay_s=0.5) for _ in range(3)]
+    xs = [None] * 3
+    barrier = threading.Barrier(3)
+
+    def run(i):
+        barrier.wait()
+        xs[i] = caches[i].get_or_factorize(a, Options())
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(x is not None for x in xs)
+    stats = [c.stats() for c in caches]
+    assert sum(s["factorizations"] for s in stats) == 1
+    # both losers resolved off the leader's publication (either
+    # adopt leg increments store_hits)
+    assert sum(s["store_hits"] for s in stats) == 2
+    # no lease left behind
+    key = matrix_key(a, Options())
+    assert caches[0].fleet.read_lease(entry_name(key)) is None
+
+
+def test_dead_leader_expired_lease_is_stolen(tmp_path):
+    """A leader that died mid-factorization (its lease stops
+    heartbeating) must not block the key forever: a follower steals
+    the expired lease and factors."""
+    a = laplacian_2d(5)
+    key = matrix_key(a, Options())
+    dead = FleetCoordinator(str(tmp_path), ttl_s=0.15,
+                            replica="dead-leader")
+    assert dead.try_acquire(entry_name(key))
+    # no heartbeat ever comes: the lease ages out
+    cache = _fleet_cache(tmp_path, ttl_s=0.15, poll_s=0.02)
+    lu = cache.get_or_factorize(a, Options())
+    assert lu is not None
+    st = cache.stats()
+    assert st["factorizations"] == 1
+    assert st["fleet_steals"] == 1
+    assert cache.fleet.read_lease(entry_name(key)) is None
+
+
+def test_heartbeat_protects_a_slow_healthy_leader(tmp_path):
+    """A lease under heartbeat NEVER reads expired, however far past
+    the TTL the leader's work runs — the property that stops a
+    follower robbing a slow-but-healthy leader.  Pinned directly on
+    the lease (no racing caches: which loser path a scheduler picks
+    is not the contract; freshness is)."""
+    co = FleetCoordinator(str(tmp_path), ttl_s=2.0)
+    assert co.try_acquire("k")
+    co._start_heartbeat("k")        # beats every ttl/4 = 0.5 s
+    try:
+        deadline = time.monotonic() + 4.5       # >2 TTLs of work
+        while time.monotonic() < deadline:
+            lease = co.read_lease("k")
+            assert lease is not None
+            assert lease.replica == co.replica  # never stolen
+            assert not lease.expired()          # never steal-able
+            time.sleep(0.05)
+    finally:
+        co.release("k")             # stops the heartbeat too
+    assert co.read_lease("k") is None
+    with co._hb_lock:
+        assert co._beats == {}
+
+
+def test_lease_steal_chaos_site_forces_the_steal_path(tmp_path):
+    """`lease_steal` chaos: a FRESH lease is treated as expired, so
+    the steal machinery is exercised without a real leader death —
+    and the stolen-lead factorization still resolves the key."""
+    a = laplacian_2d(5)
+    key = matrix_key(a, Options())
+    other = FleetCoordinator(str(tmp_path), ttl_s=30.0,
+                             replica="healthy-other")
+    assert other.try_acquire(entry_name(key))
+    chaos.install("lease_steal=1", seed=0)
+    cache = _fleet_cache(tmp_path, ttl_s=30.0, poll_s=0.01)
+    lu = cache.get_or_factorize(a, Options())
+    chaos.uninstall()
+    assert lu is not None
+    assert cache.stats()["fleet_steals"] >= 1
+    assert cache.stats()["factorizations"] == 1
+
+
+def test_fleet_coordinator_env_hookup(tmp_path, monkeypatch):
+    """SLU_FLEET=1 attaches a coordinator over the store's own
+    directory; without a store there is nothing to coordinate."""
+    monkeypatch.setenv("SLU_FLEET", "1")
+    c = FactorCache(backend="host", store=FactorStore(str(tmp_path)))
+    assert c.fleet is not None
+    assert c.fleet.root == str(tmp_path)
+    assert FactorCache(backend="host").fleet is None
+    # an EXPLICIT opt-out (ServeConfig(fleet=False) / fleet=False)
+    # beats the env: SLU_FLEET=1 must not resurrect it
+    assert FactorCache(backend="host",
+                       store=FactorStore(str(tmp_path)),
+                       fleet=False).fleet is None
+    svc = SolveService(ServeConfig(backend="host",
+                                   store_dir=str(tmp_path),
+                                   fleet=False))
+    assert svc.cache.fleet is None
+    svc.close()
+    # an EXPLICIT request works without the env flag too, including
+    # over a store the cache resolved from SLU_FT_STORE
+    monkeypatch.delenv("SLU_FLEET")
+    monkeypatch.setenv("SLU_FT_STORE", str(tmp_path))
+    svc = SolveService(ServeConfig(backend="host", fleet=True))
+    assert svc.cache.fleet is not None
+    assert svc.cache.fleet.root == str(tmp_path)
+    svc.close()
+    monkeypatch.delenv("SLU_FT_STORE")
+    monkeypatch.setenv("SLU_FLEET", "0")
+    assert FactorCache(backend="host",
+                       store=FactorStore(str(tmp_path))).fleet is None
+
+
+# --------------------------------------------------------------------
+# replica pool: routing + typed failover into the degraded path
+# --------------------------------------------------------------------
+
+def test_pool_routes_home_then_fails_over_to_degraded(tmp_path):
+    """The satellite pin: a consistent-hash route whose home replica
+    is dead fails over to a survivor whose key is CIRCUIT-BROKEN —
+    and the answer is a DegradedResult through the stale-factor path
+    with `route.failover` stamped on the flight record, never an
+    untyped error."""
+    flight.configure(enabled=True)
+    a = laplacian_2d(6)
+    a2 = _drift(a, 1.0 + 1e-8)
+    key2 = matrix_key(a2, Options())
+    svcs = {n: SolveService(ServeConfig(
+        backend="host", breaker_threshold=1, breaker_cooldown_s=60.0,
+        degraded=True)) for n in ("rA", "rB")}
+    pool = ReplicaPool(svcs)
+    order = pool.route_for(a2, Options())
+    home, fallback = order[0], order[1]
+    # the fallback replica holds STALE same-pattern factors and an
+    # OPEN breaker for the drifted key
+    svcs[fallback].prefactor(a, Options())
+    svcs[fallback].cache.breaker.record_failure(key2)
+    assert not svcs[fallback].cache.breaker.allow(key2)
+    pool.mark_down(home)
+
+    x = pool.solve(a2, np.ones(a.n))
+    assert isinstance(x, DegradedResult)
+    assert np.all(np.isfinite(np.asarray(x)))
+    assert svcs[fallback].metrics.counter("serve.degraded_served") == 1
+    # the pool-level flight record: route.failover hop + degraded
+    recs = [r for r in flight.get_recorder().records()
+            if r["meta"].get("scope") == "fleet"]
+    assert recs, "pool requests must carry a fleet-scope record"
+    rec = recs[-1]
+    assert rec["outcome"] == "degraded"
+    assert rec["meta"]["served_by"] == fallback
+    hops = [e for e in rec["events"] if e["stage"] == "route.failover"]
+    assert hops and hops[0]["frm"] == home
+    for svc in svcs.values():
+        svc.close()
+
+
+def test_pool_serves_home_directly_when_healthy(tmp_path):
+    a = laplacian_2d(6)
+    svcs = {n: SolveService(ServeConfig(backend="host"))
+            for n in ("rA", "rB")}
+    pool = ReplicaPool(svcs)
+    home = pool.route_for(a, Options())[0]
+    x = pool.solve(a, np.ones(a.n))
+    assert not isinstance(x, DegradedResult)
+    assert np.all(np.isfinite(x))
+    # the home replica, and only the home replica, factored
+    assert svcs[home].cache.stats()["factorizations"] == 1
+    other = [n for n in svcs if n != home][0]
+    assert svcs[other].cache.stats()["factorizations"] == 0
+    for svc in svcs.values():
+        svc.close()
+
+
+def test_pool_never_reroutes_economics():
+    """Deadline/rejection are pushback, not faults: rerouting them
+    would amplify load — they raise."""
+    a = laplacian_2d(6)
+    svcs = {n: SolveService(ServeConfig(backend="host"))
+            for n in ("rA", "rB")}
+    pool = ReplicaPool(svcs)
+    with pytest.raises(DeadlineExceeded):
+        pool.solve(a, np.ones(a.n), deadline_s=0.0)
+    for svc in svcs.values():
+        svc.close()
+
+
+def test_pool_route_key_is_process_stable():
+    """Routing must agree across processes (the drill's driver and
+    replicas compute homes independently): the ring coordinate may
+    not depend on PYTHONHASHSEED."""
+    a = laplacian_2d(6)
+    key = matrix_key(a, Options())
+    rk = _route_key(key)
+    code = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "from superlu_dist_tpu import Options\n"
+        "from superlu_dist_tpu.fleet.pool import _route_key\n"
+        "from superlu_dist_tpu.serve import matrix_key\n"
+        "from superlu_dist_tpu.utils.testmat import laplacian_2d\n"
+        "print(_route_key(matrix_key(laplacian_2d(6), Options())))\n"
+    ).format(repo=_REPO)
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=_subprocess_env(), capture_output=True,
+                         text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == rk
+
+
+# --------------------------------------------------------------------
+# chaos: the fleet sites
+# --------------------------------------------------------------------
+
+def test_fleet_chaos_sites_deterministic_and_validated():
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        chaos.ChaosPolicy("lease_steel=1")
+    for site in ("store_latency", "lease_steal", "replica_kill"):
+        p1 = chaos.ChaosPolicy(f"{site}=0.5", seed=11)
+        p2 = chaos.ChaosPolicy(f"{site}=0.5", seed=11)
+        assert [p1.should(site) for _ in range(64)] \
+            == [p2.should(site) for _ in range(64)]
+
+
+def test_fleet_chaos_sites_off_path_inert(tmp_path):
+    """Chaos off: the new sites are a pointer check — no sleep, no
+    steal, no kill armed."""
+    assert chaos.active() is None
+    t0 = time.monotonic()
+    chaos.maybe_sleep("store_latency", 5.0)
+    assert time.monotonic() - t0 < 1.0
+    assert chaos.maybe_replica_kill() is False
+    assert not chaos.should("lease_steal")
+    # and a spec naming OTHER sites leaves these inert too
+    chaos.install("latency=1:0.0", seed=0)
+    assert not chaos.should("lease_steal")
+    assert chaos.maybe_replica_kill() is False
+    chaos.uninstall()
+
+
+def test_replica_kill_site_dies_by_sigkill():
+    """`replica_kill` is a genuine kill -9: the armed process dies by
+    SIGKILL (no cleanup, no exit handlers), which is exactly what the
+    drill's survivors must absorb."""
+    code = (
+        "import sys, time; sys.path.insert(0, {repo!r})\n"
+        "from superlu_dist_tpu.resilience import chaos\n"
+        "chaos.install('replica_kill=1:0.0')\n"
+        "assert chaos.maybe_replica_kill()\n"
+        "time.sleep(30)\n"
+        "print('survived')\n"
+    ).format(repo=_REPO)
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=_subprocess_env(), capture_output=True,
+                         text=True, timeout=240)
+    assert out.returncode == -signal.SIGKILL, (out.returncode,
+                                               out.stdout, out.stderr)
+    assert "survived" not in out.stdout
+
+
+# --------------------------------------------------------------------
+# multi-process pins: single-flight and the store write race
+# --------------------------------------------------------------------
+
+_WORKER_SINGLE_FLIGHT = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.fleet import FleetCoordinator
+from superlu_dist_tpu.models.gssvx import factorize
+from superlu_dist_tpu.resilience.store import FactorStore
+from superlu_dist_tpu.serve import FactorCache
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+def slow(a, options, plan):
+    time.sleep(0.5)
+    return factorize(a, options, plan=plan, backend='host')
+
+cache = FactorCache(
+    backend='host', store=FactorStore({store!r}),
+    fleet=FleetCoordinator({store!r}, ttl_s=30.0, poll_s=0.02),
+    factorize_fn=slow)
+a = laplacian_2d(5)
+lu = cache.get_or_factorize(a, Options())
+assert lu is not None
+st = cache.stats()
+print('STATS', st['factorizations'], st['fleet_adopted'],
+      st['store_hits'])
+"""
+
+
+def test_single_flight_across_two_processes(tmp_path):
+    """The tentpole pin: two real PROCESSES race one cold key on one
+    shared store — exactly one factorization fleet-wide (in-process
+    single-flight cannot reach here; the lease protocol must)."""
+    store = str(tmp_path)
+    code = _WORKER_SINGLE_FLIGHT.format(repo=_REPO, store=store)
+    procs = [subprocess.Popen([sys.executable, "-c", code],
+                              env=_subprocess_env(),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    stats = [tuple(map(int, so.split("STATS", 1)[1].split()))
+             for so, _ in outs]
+    total_factorizations = sum(s[0] for s in stats)
+    assert total_factorizations == 1, stats
+    # the non-leader adopted (either via fleet wait or plain store
+    # read-through, depending on arrival order)
+    assert sum(s[2] for s in stats) == 1, stats
+
+
+_WORKER_STORE_RACE = """
+import sys
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.models.gssvx import factorize, solve
+from superlu_dist_tpu.resilience.store import FactorStore
+from superlu_dist_tpu.serve import matrix_key
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+import numpy as np
+
+store = FactorStore({store!r})
+a = laplacian_2d(5)
+key = matrix_key(a, Options())
+lu = factorize(a, Options(), backend='host')
+x_ref = solve(lu, np.ones(a.n))
+hits = misses = 0
+for i in range(40):
+    store.save(key, lu)                    # atomic publish
+    got = store.load(key)                  # verified or miss
+    if got is None:
+        misses += 1
+    else:
+        hits += 1
+        np.testing.assert_allclose(solve(got, np.ones(a.n)),
+                                   x_ref, rtol=1e-12)
+    if i % 10 == {which}:                  # staggered quarantines
+        store.quarantine(store.path_for(key), reason='race test')
+print('RACE', hits, misses)
+"""
+
+
+def test_two_writers_hammering_one_key_never_corrupt(tmp_path):
+    """The satellite pin: two replica processes save/load/quarantine
+    ONE key concurrently.  Every load must be a verified hit (solving
+    identically) or a clean miss — never an OSError, never torn
+    bytes.  The per-process tmp naming + atomic rename discipline is
+    what this exercises."""
+    store = str(tmp_path)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _WORKER_STORE_RACE.format(repo=_REPO, store=store,
+                                   which=i * 5)],
+        env=_subprocess_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+        assert "RACE" in so
+    # at least one writer saw verified hits; no writer crashed
+    hits = sum(int(so.split("RACE", 1)[1].split()[0])
+               for so, _ in outs)
+    assert hits > 0
+    # no tmp litter survived the race (atomic_write cleans up)
+    leftovers = [f for f in os.listdir(store)
+                 if f.startswith(".tmp-")]
+    assert leftovers == []
+
+
+def test_concurrent_quarantine_reads_as_miss_not_error(tmp_path):
+    """A `*.quarantined` rename by another replica between the
+    existence check and the open reads as a MISS (extends the PR 5
+    in-process concurrent-quarantine contract to the multi-process
+    store)."""
+    a = laplacian_2d(5)
+    key = matrix_key(a, Options())
+    store_a = FactorStore(str(tmp_path))
+    store_b = FactorStore(str(tmp_path))
+    lu = factorize(a, Options(), backend="host")
+    store_a.save(key, lu)
+    assert store_a.contains(key)
+    # replica B quarantines it between A's contains() and load()
+    store_b.quarantine(store_b.path_for(key), reason="concurrent")
+    assert store_a.load(key) is None               # miss, no raise
+    # double-quarantine (both replicas decide simultaneously): the
+    # second rename fails silently, never raises
+    store_a.quarantine(store_a.path_for(key), reason="second")
+    assert store_a.quarantined() != []

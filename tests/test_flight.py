@@ -414,3 +414,66 @@ def test_slo_counts_rejections_as_failures():
     snap = slo.snapshot()
     assert snap["keys"]["unrouted"]["failed"] == rejected
     svc.close()
+
+
+# --------------------------------------------------------------------
+# fleet rids: replica-disambiguated across processes
+# --------------------------------------------------------------------
+
+_RID_WORKER = """
+import sys
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu.obs import flight
+rec = flight.configure(enabled=True, jsonl_path={log!r})
+for _ in range(3):
+    r = rec.start(worker={which})
+    r.event("probe")
+    r.finish("ok")
+rec.close()
+print("REPLICA", flight.replica_id())
+"""
+
+
+def test_rids_disambiguated_by_replica_across_processes(tmp_path):
+    """The satellite pin: the lock-free rid counter is per-process,
+    so two replicas sharing one SLU_FLIGHT_JSONL emit COLLIDING plain
+    rids — every record must carry the replica id (pid+boot-nonce)
+    that makes (replica, rid) fleet-unique, and trace_export must
+    group the merged log per-replica."""
+    import os
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log = str(tmp_path / "fleet_flight.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [_sys.executable, "-c",
+         _RID_WORKER.format(repo=repo, log=log, which=i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for i in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    replicas_printed = set()
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+        replicas_printed.add(so.split("REPLICA", 1)[1].strip())
+    assert len(replicas_printed) == 2    # distinct pid+boot-nonce ids
+
+    recs = [json.loads(ln) for ln in open(log) if ln.strip()]
+    assert len(recs) == 6
+    plain = [r["rid"] for r in recs]
+    assert len(set(plain)) < len(plain), \
+        "per-process rids DO collide — that is the hazard"
+    pairs = {(r["replica"], r["rid"]) for r in recs}
+    assert len(pairs) == 6               # fleet-unique composite id
+    assert {r["replica"] for r in recs} == replicas_printed
+
+    # trace_export groups the merged log per-replica: distinct pid
+    # per (replica, rid), replica named on the track
+    from tools import trace_export
+    events = trace_export.flight_to_chrome(recs)
+    trace_export.validate_events(events)
+    assert len({e["pid"] for e in events}) == 6
+    names = [e["args"]["name"] for e in events if e["ph"] == "M"]
+    assert all("replica" in n for n in names)

@@ -1,0 +1,583 @@
+"""Fleet drill: ≥3 replica processes, one shared store, one kill -9.
+
+The multi-process proof of the fleet layer (superlu_dist_tpu/fleet/),
+gated the way CHAOS.jsonl gates the single-replica story:
+
+  1. COLD BURST — the same cold key is thrown at every replica
+     simultaneously.  Cross-process single-flight (fleet/lease.py)
+     must elect one leader: the pool-wide factorization count for the
+     key is exactly 1, everyone else adopts the published entry.
+  2. PREFACTOR — each remaining key is served once at its
+     consistent-hash home (fleet/router.py), publishing every key to
+     the shared store.  `fleet_factorizations_per_cold_key` — total
+     factorizations across the pool over total cold keys — must be
+     exactly 1.0.
+  3. CHAOS LOAD + KILL — closed-loop load routed by the ring under
+     injected store latency; mid-load the HOME of the hot key is
+     killed with SIGKILL via the `replica_kill` chaos site (armed
+     over the wire: the process dies the way `kill -9` kills it).
+     The driver's clients treat the connection reset as the death
+     signal, mark the replica down, and fail over along the ring.
+     Gates: zero lost requests (every request reaches a final
+     ok/degraded/typed outcome), zero hung workers, and WARM TAKEOVER
+     — survivors absorb the victim's keys with factorizations == 0
+     (they adopt from the store; they never re-factor).
+
+All replicas append flight records to ONE shared SLU_FLIGHT_JSONL —
+the fleet trace.  The drill verifies the per-process rids are
+disambiguated by replica id ((replica, rid) unique across the merged
+log) and that tools/trace_export.py converts it per-replica.
+
+One JSON line is appended to SLU_FLEET_OUT (default FLEET.jsonl);
+tools/regress.py gates the committed history.  Wire-up:
+`python -m tools.fleet_drill`, `python bench.py --fleet`, or the
+tpu_fire.sh fleet step.  Knobs: SLU_FLEET_REPLICAS / SLU_FLEET_K /
+SLU_FLEET_REQUESTS / SLU_FLEET_KILL_AFTER / SLU_FLEET_TTL_S.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+_AUTHKEY = b"slu-fleet-drill"
+
+
+def _repo() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _drill_matrices(k: int, n_keys: int):
+    """The drill's key family: distinct PATTERNS (different grid
+    sizes), so the hash ring spreads them across homes.  Sizes stay
+    tiny — the drill proves coordination, not kernels."""
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+    return [laplacian_3d(k + i) for i in range(n_keys)]
+
+
+# --------------------------------------------------------------------
+# replica process
+# --------------------------------------------------------------------
+
+def run_replica(name: str, socket_path: str, store_dir: str,
+                k: int, n_keys: int, factor_delay_s: float,
+                ttl_s: float) -> None:
+    """One replica: a SolveService on the shared store with fleet
+    single-flight, served over a unix socket.  Protocol: one pickled
+    dict per request — solve / stats / chaos / chaos_off / die /
+    ping / close."""
+    from multiprocessing.connection import Listener
+
+    import numpy as np
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.fleet.lease import FleetCoordinator
+    from superlu_dist_tpu.models.gssvx import factorize
+    from superlu_dist_tpu.obs import flight
+    from superlu_dist_tpu.resilience import chaos
+    from superlu_dist_tpu.resilience.store import FactorStore
+    from superlu_dist_tpu.serve import (DegradedResult, FactorCache,
+                                        ServeConfig, ServeError,
+                                        SolveService)
+
+    flight.configure()          # adopt SLU_FLIGHT_JSONL from the env
+    mats = _drill_matrices(k, n_keys)
+    opts = Options(factor_dtype="float64")
+
+    def slow_factorize(a, options, plan):
+        # stand-in for the minutes-long production factorization:
+        # wide enough a window that the cold burst genuinely races
+        if factor_delay_s > 0:
+            time.sleep(factor_delay_s)
+        from superlu_dist_tpu.plan.plan import plan_factorization
+        if plan is None:
+            plan = plan_factorization(a, options)
+        return factorize(a, options, plan=plan, backend="host")
+
+    store = FactorStore(store_dir)
+    svc = SolveService(ServeConfig(
+        max_queue_depth=1024, backend="host", degraded=True,
+        factor_retries=1, retry_base_s=0.01,
+        breaker_threshold=3, breaker_cooldown_s=1.0, fleet=False),
+        cache=FactorCache(
+            backend="host", store=store,
+            fleet=FleetCoordinator(store_dir, ttl_s=ttl_s,
+                                   poll_s=0.02),
+            factorize_fn=slow_factorize))
+
+    def handle(conn) -> None:
+        rng_cache: dict = {}
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            cmd = msg.get("cmd")
+            try:
+                if cmd == "ping":
+                    conn.send({"pong": os.getpid(),
+                               "replica": flight.replica_id()})
+                elif cmd == "solve":
+                    i = int(msg["key_i"])
+                    a = mats[i]
+                    seed = int(msg.get("seed", 0))
+                    rng = rng_cache.setdefault(
+                        seed, np.random.default_rng(seed))
+                    b = rng.standard_normal(a.n)
+                    info: dict = {}
+                    try:
+                        x = svc.solve(a, b, options=opts,
+                                      deadline_s=msg.get("deadline_s"),
+                                      info=info)
+                        status = ("nonfinite"
+                                  if not np.all(np.isfinite(x))
+                                  else "degraded"
+                                  if isinstance(x, DegradedResult)
+                                  else "ok")
+                    except ServeError as e:
+                        status = type(e).__name__
+                    conn.send({"status": status,
+                               "rid": info.get("request_id"),
+                               "replica": flight.replica_id()})
+                elif cmd == "stats":
+                    st = svc.cache.stats()
+                    conn.send({
+                        "replica": flight.replica_id(),
+                        "pid": os.getpid(),
+                        "cache": st,
+                        "flight": {
+                            k_: v for k_, v in
+                            flight.snapshot().items()
+                            if k_ in ("replica", "started",
+                                      "finished", "by_outcome")},
+                    })
+                elif cmd == "chaos":
+                    chaos.install(msg["spec"],
+                                  seed=int(msg.get("seed", 0)))
+                    conn.send({"ok": True})
+                elif cmd == "chaos_off":
+                    chaos.uninstall()
+                    conn.send({"ok": True})
+                elif cmd == "die":
+                    # the drill's kill -9: arm the replica_kill chaos
+                    # site and fire it — a SIGKILL with no cleanup
+                    chaos.install(
+                        f"replica_kill=1:{float(msg.get('delay', 0))}")
+                    armed = chaos.maybe_replica_kill()
+                    conn.send({"armed": armed})
+                elif cmd == "close":
+                    conn.send({"ok": True})
+                    os._exit(0)
+                else:
+                    conn.send({"error": f"unknown cmd {cmd!r}"})
+            except (EOFError, OSError):
+                break
+
+    # backlog: the drill's workers open one connection per request
+    # concurrently; the Listener default of 1 refuses the burst and
+    # a refused connect is indistinguishable from a dead replica
+    with Listener(socket_path, family="AF_UNIX", backlog=128,
+                  authkey=_AUTHKEY) as listener:
+        # readiness marker: the driver polls for this file, then pings
+        with open(socket_path + ".ready", "w") as f:
+            f.write(str(os.getpid()))
+        while True:
+            conn = listener.accept()
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+
+# --------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------
+
+class _ReplicaClient:
+    """Driver-side request issuing with ring failover: one connection
+    per request (the drill's volumes are tiny), a connection error IS
+    the replica-death signal."""
+
+    def __init__(self, sockets: dict, ring, down: set,
+                 lock: threading.Lock) -> None:
+        self.sockets = sockets
+        self.ring = ring
+        self.down = down
+        self.lock = lock
+        self.failovers = 0
+
+    def _is_down(self, name: str) -> bool:
+        with self.lock:
+            return name in self.down
+
+    def _mark_down(self, name: str) -> None:
+        with self.lock:
+            self.down.add(name)
+
+    def request(self, order: list, msg: dict,
+                timeout_s: float = 60.0,
+                ignore_down: bool = False) -> dict | None:
+        """Send `msg` to the first live replica in `order`, failing
+        over on connection death.  A transient connect refusal is
+        retried before the replica is declared dead (a full accept
+        queue must not read as a kill); an EOF mid-conversation IS
+        the death signal.  None = every replica refused (the 'lost'
+        outcome the gate forbids).  `ignore_down` bypasses the
+        down-set for post-mortem stats collection."""
+        from multiprocessing.connection import Client
+        for name in order:
+            if not ignore_down and self._is_down(name):
+                with self.lock:
+                    self.failovers += 1
+                continue
+            for attempt in range(3):
+                try:
+                    with Client(self.sockets[name], family="AF_UNIX",
+                                authkey=_AUTHKEY) as c:
+                        c.send(msg)
+                        if not c.poll(timeout_s):
+                            raise EOFError("reply timeout")
+                        out = c.recv()
+                        out["served_by"] = name
+                        return out
+                except (EOFError, ConnectionResetError,
+                        BrokenPipeError):
+                    break          # died mid-conversation: no retry
+                except (OSError, ConnectionError):
+                    time.sleep(0.05)     # transient refusal: retry
+            # retries exhausted or mid-flight death: mark down and
+            # walk the chain — the request is NOT lost
+            self._mark_down(name)
+            with self.lock:
+                self.failovers += 1
+        return None
+
+
+def run_drill(argv=()) -> dict:
+    import shutil
+    import tempfile
+
+    repo = _repo()
+    sys.path.insert(0, repo)
+    n_replicas = max(3, int(os.environ.get("SLU_FLEET_REPLICAS", "3")))
+    k = int(os.environ.get("SLU_FLEET_K", "4"))
+    requests = int(os.environ.get("SLU_FLEET_REQUESTS", "48"))
+    kill_after = float(os.environ.get("SLU_FLEET_KILL_AFTER", "0.33"))
+    # unset or "0" -> the drill's own 20 s TTL (NOT default_ttl_s(),
+    # which scales off the measured minutes-class factorization and
+    # would dwarf the drill's 60 s per-request / 300 s join budgets)
+    ttl_s = float(os.environ.get("SLU_FLEET_TTL_S") or 0.0) or 20.0
+    out_path = os.environ.get("SLU_FLEET_OUT",
+                              os.path.join(repo, "FLEET.jsonl"))
+    n_keys = 4
+    factor_delay_s = 0.5
+    workdir = tempfile.mkdtemp(prefix="slu_fleet_")
+    store_dir = os.path.join(workdir, "store")
+    flight_log = os.path.join(workdir, "fleet_flight.jsonl")
+    os.makedirs(store_dir, exist_ok=True)
+
+    names = [f"r{i}" for i in range(n_replicas)]
+    sockets = {n: os.path.join(workdir, n + ".sock") for n in names}
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["SLU_FLIGHT_JSONL"] = flight_log     # ONE shared fleet trace
+    env["SLU_FLEET_TTL_S"] = str(ttl_s)
+
+    procs: dict = {}
+    report: dict = {"mode": "fleet", "replicas": n_replicas, "k": k,
+                    "requests": requests, "keys": n_keys,
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        for n in names:
+            procs[n] = subprocess.Popen(
+                [sys.executable, "-m", "tools.fleet_drill",
+                 "--replica", "--name", n, "--socket", sockets[n],
+                 "--store", store_dir, "--k", str(k),
+                 "--keys", str(n_keys),
+                 "--factor-delay", str(factor_delay_s),
+                 "--ttl", str(ttl_s)],
+                cwd=repo, env=env)
+        down: set = set()
+        lock = threading.Lock()
+
+        from superlu_dist_tpu import Options
+        from superlu_dist_tpu.fleet.pool import _route_key
+        from superlu_dist_tpu.fleet.router import HashRing
+        from superlu_dist_tpu.serve import matrix_key
+        ring = HashRing(names)
+        client = _ReplicaClient(sockets, ring, down, lock)
+
+        # readiness: each replica drops a .ready marker, then answers
+        # pings — budget generous for cold jax imports
+        deadline = time.monotonic() + 180.0
+        for n in names:
+            while not os.path.exists(sockets[n] + ".ready"):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"replica {n} never came up")
+                time.sleep(0.1)
+            while client.request([n], {"cmd": "ping"}, 10.0) is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"replica {n} never answered")
+                time.sleep(0.2)
+        print(f"# fleet: {n_replicas} replicas up", file=sys.stderr)
+
+        mats = _drill_matrices(k, n_keys)
+        opts = Options(factor_dtype="float64")
+        keys = [matrix_key(m, opts) for m in mats]
+        routes = [ring.route(_route_key(kk)) for kk in keys]
+
+        # --- phase 1: COLD BURST — same cold key at every replica at
+        # once; cross-process single-flight must factor it ONCE
+        burst: list = [None] * n_replicas
+
+        def burst_one(i: int, n: str) -> None:
+            burst[i] = client.request(
+                [n], {"cmd": "solve", "key_i": 0, "seed": 100 + i},
+                timeout_s=120.0)
+
+        ts = [threading.Thread(target=burst_one, args=(i, n))
+              for i, n in enumerate(names)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stats1 = {n: client.request([n], {"cmd": "stats"}, 30.0)
+                  for n in names}
+        burst_factorizations = sum(
+            s["cache"]["factorizations"] for s in stats1.values())
+        report["cold_burst"] = {
+            "outcomes": [r and r["status"] for r in burst],
+            "factorizations": burst_factorizations,
+            "adopted": sum(s["cache"]["fleet_adopted"]
+                           for s in stats1.values()),
+            "store_hits": sum(s["cache"]["store_hits"]
+                              for s in stats1.values()),
+        }
+        print(f"# fleet: cold burst factored "
+              f"{burst_factorizations}x pool-wide", file=sys.stderr)
+
+        # --- phase 2: PREFACTOR the rest at their ring homes
+        for i in range(1, n_keys):
+            r = client.request(routes[i],
+                               {"cmd": "solve", "key_i": i,
+                                "seed": 200 + i}, timeout_s=120.0)
+            assert r is not None and r["status"] == "ok", r
+        stats2 = {n: client.request([n], {"cmd": "stats"}, 30.0)
+                  for n in names}
+        total_factorizations = sum(
+            s["cache"]["factorizations"] for s in stats2.values())
+        report["fleet_factorizations_per_cold_key"] = \
+            total_factorizations / n_keys
+        prekill = {n: s["cache"]["factorizations"]
+                   for n, s in stats2.items()}
+
+        # --- phase 3: CHAOS LOAD + KILL the hot key's home
+        victim = routes[0][0]
+        for n in names:
+            client.request([n], {"cmd": "chaos",
+                                 "spec": "store_latency=0.3:0.01,"
+                                         "latency=0.1:0.002",
+                                 "seed": 0}, 30.0)
+        statuses: list = []
+        st_lock = threading.Lock()
+        kill_at = max(1, int(requests * kill_after))
+        served = [0]
+        killed = [False]
+
+        def kill_victim() -> None:
+            print(f"# fleet: kill -9 {victim} "
+                  f"(pid {procs[victim].pid})", file=sys.stderr)
+            client.request([victim], {"cmd": "die", "delay": 0.0},
+                           10.0, ignore_down=True)
+            time.sleep(0.3)
+            if procs[victim].poll() is None:
+                # the socket died before the arm landed: double-tap
+                import signal as _sig
+                os.kill(procs[victim].pid, _sig.SIGKILL)
+
+        n_workers = min(6, requests)
+        counts = [requests // n_workers] * n_workers
+        for i in range(requests % n_workers):
+            counts[i] += 1
+
+        def worker(wid: int, n_req: int) -> None:
+            import numpy as _np
+            rng = _np.random.default_rng(1000 + wid)
+            for j in range(n_req):
+                # think time spreads the load so the kill lands
+                # MID-load, with requests genuinely in flight at the
+                # victim when it dies
+                time.sleep(float(rng.exponential(0.03)))
+                ki = int(rng.integers(n_keys)) \
+                    if rng.random() > 0.5 else 0     # hot key 0
+                r = client.request(routes[ki],
+                                   {"cmd": "solve", "key_i": ki,
+                                    "seed": wid * 10000 + j},
+                                   timeout_s=60.0)
+                with st_lock:
+                    statuses.append(r["status"] if r else "lost")
+                    served[0] += 1
+                    if served[0] >= kill_at and not killed[0]:
+                        killed[0] = True
+                        threading.Thread(target=kill_victim,
+                                         daemon=True).start()
+
+        workers = [threading.Thread(target=worker, args=(i, c),
+                                    daemon=True)
+                   for i, c in enumerate(counts)]
+        t0 = time.monotonic()
+        for w in workers:
+            w.start()
+        join_deadline = t0 + 300.0
+        for w in workers:
+            w.join(max(0.0, join_deadline - time.monotonic()))
+        hung = sum(1 for w in workers if w.is_alive())
+        wall_s = time.monotonic() - t0
+
+        survivors = [n for n in names if n != victim]
+        stats3 = {}
+        for n in survivors:
+            s = client.request([n], {"cmd": "stats"}, 30.0,
+                               ignore_down=True)
+            if s is not None:
+                stats3[n] = s
+        by_status: dict = {}
+        for s in statuses:
+            by_status[s] = by_status.get(s, 0) + 1
+        takeover = sum(
+            stats3[n]["cache"]["factorizations"] - prekill[n]
+            for n in stats3)
+        report.update({
+            "victim": victim,
+            "by_status": by_status,
+            "lost": by_status.get("lost", 0),
+            # requests that produced NO status at all (a worker died
+            # to an uncaught exception mid-loop): without this, a
+            # dead worker's unissued requests would vanish from both
+            # the lost and hung accounting and the gate would pass
+            # with work unaccounted for
+            "unaccounted": requests - len(statuses),
+            "hung": hung,
+            "wall_s": round(wall_s, 3),
+            "route_failovers": client.failovers,
+            "takeover_factorizations": takeover,
+            "survivor_stats": {
+                n: {"factorizations": s["cache"]["factorizations"],
+                    "store_hits": s["cache"]["store_hits"],
+                    "fleet_adopted": s["cache"]["fleet_adopted"],
+                    "fleet_steals": s["cache"]["fleet_steals"]}
+                for n, s in stats3.items()},
+        })
+
+        # --- fleet trace: (replica, rid) must be unique across the
+        # merged log, and trace_export must convert it per-replica
+        report["flight_trace"] = _check_fleet_trace(flight_log)
+
+        for n in survivors:
+            client.request([n], {"cmd": "close"}, 10.0,
+                           ignore_down=True)
+    finally:
+        for n, p in procs.items():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    untyped = sum(v for s, v in report["by_status"].items()
+                  if s not in ("ok", "degraded") and s != "lost"
+                  and not s[:1].isupper())
+    report["platform"] = env.get("JAX_PLATFORMS", "cpu").split(",")[0]
+    report["gate"] = {
+        "zero_lost": report["lost"] == 0,
+        "zero_hung": report["hung"] == 0,
+        "all_accounted": report["unaccounted"] == 0,
+        "single_flight": report["cold_burst"]["factorizations"] == 1,
+        "one_factorization_per_cold_key":
+            report["fleet_factorizations_per_cold_key"] == 1.0,
+        "warm_takeover": report["takeover_factorizations"] == 0,
+        "failover_exercised": report["route_failovers"] > 0,
+        "all_typed": untyped == 0,
+        "rids_fleet_unique":
+            report["flight_trace"].get("rids_unique", False),
+    }
+    report["gate"]["passed"] = all(report["gate"].values())
+
+    line = json.dumps(report)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    if not report["gate"]["passed"]:
+        print(f"# FLEET GATE FAILED: {report['gate']}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return report
+
+
+def _check_fleet_trace(flight_log: str) -> dict:
+    """Parse the replicas' shared flight JSONL: per-process rids must
+    be disambiguated by replica id, and trace_export must group the
+    merged log per-replica."""
+    recs = []
+    try:
+        with open(flight_log) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        recs.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        return {"records": 0, "rids_unique": False}
+    pairs = [(r.get("replica"), r.get("rid")) for r in recs]
+    replicas = {p[0] for p in pairs if p[0]}
+    plain_rids = [p[1] for p in pairs]
+    out = {
+        "records": len(recs),
+        "replicas": len(replicas),
+        "plain_rid_collisions":
+            len(plain_rids) - len(set(plain_rids)),
+        "rids_unique": (len(pairs) == len(set(pairs))
+                        and len(replicas) >= 2 and len(recs) > 0),
+    }
+    try:
+        from tools.trace_export import flight_to_chrome
+        events = flight_to_chrome(recs)
+        pids = {e["pid"] for e in events}
+        out["trace_events"] = len(events)
+        out["trace_pids_unique_per_request"] = \
+            len(pids) == len(set(pairs))
+    except Exception as e:
+        out["trace_error"] = repr(e)
+    return out
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if "--replica" in argv:
+        def opt(flag, default=None):
+            return (argv[argv.index(flag) + 1] if flag in argv
+                    else default)
+        run_replica(name=opt("--name", "r?"),
+                    socket_path=opt("--socket"),
+                    store_dir=opt("--store"),
+                    k=int(opt("--k", "4")),
+                    n_keys=int(opt("--keys", "4")),
+                    factor_delay_s=float(opt("--factor-delay", "0.5")),
+                    ttl_s=float(opt("--ttl", "20")))
+        return
+    repo = _repo()
+    run_drill(argv)
+    if os.environ.get("SLU_REGRESS", "1") != "0":
+        sys.path.insert(0, repo)
+        from tools import regress
+        findings, passed = regress.check_repo(repo)
+        print(regress.format_findings(findings), file=sys.stderr)
+        if not passed:
+            raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,10 @@ tolerance:
                  class; absolute drift within a class is noise)
   * chaos      — unresolved == 0, nonfinite == 0, untyped == 0,
                  gate.passed
+  * fleet      — lost == 0, hung == 0,
+                 fleet_factorizations_per_cold_key == 1,
+                 takeover_factorizations == 0, gate.passed
+                 (the multi-process drill record, FLEET.jsonl)
   * bench      — GFLOP/s floor
 
 Usage:
@@ -163,6 +167,9 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "CHAOS.jsonl")):
         if rec.get("mode") == "chaos":
             add(rec.get("platform"), "chaos", rec)
+    for rec in _read_jsonl(os.path.join(root, "FLEET.jsonl")):
+        if rec.get("mode") == "fleet":
+            add(rec.get("platform"), "fleet", rec)
     for rec in _bench_records(root):
         add(rec.get("platform"), "bench", rec)
     return hist
@@ -309,6 +316,40 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     p, chk, "gate.passed", ok, True, True,
                     "ok" if ok else "fail",
                     "" if ok else "the chaos gate itself failed"))
+            elif chk == "fleet":
+                zero_check(p, chk, "lost", _num(latest, "lost"),
+                           "a request was lost fleet-wide (no "
+                           "replica produced an outcome)")
+                zero_check(p, chk, "hung", _num(latest, "hung"),
+                           "a drill worker hung")
+                zero_check(p, chk, "unaccounted",
+                           _num(latest, "unaccounted"),
+                           "a drill worker died with requests "
+                           "unaccounted for")
+                zero_check(p, chk, "takeover_factorizations",
+                           _num(latest, "takeover_factorizations"),
+                           "a survivor re-factored a published key "
+                           "instead of adopting it warm")
+                v = _num(latest, "fleet_factorizations_per_cold_key")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "fleet_factorizations_per_cold_key",
+                        None, 1.0, 1.0, "skip", "metric absent"))
+                else:
+                    ok = v == 1.0
+                    findings.append(_finding(
+                        p, chk, "fleet_factorizations_per_cold_key",
+                        v, 1.0, 1.0, "ok" if ok else "fail",
+                        "" if ok else "a cold key factored more (or "
+                        "less) than exactly once across the pool — "
+                        "cross-process single-flight broke"))
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the fleet drill gate itself "
+                    "failed"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -361,6 +402,8 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                                      for a, v in sorted(berr.items())}}
             elif chk == "chaos":
                 dst[chk] = {}
+            elif chk == "fleet":
+                dst[chk] = {}          # structural zero-gates only
             elif chk == "bench":
                 dst[chk] = {"gflops": _median(
                     [v for r in win

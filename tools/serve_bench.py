@@ -526,6 +526,13 @@ def _regress_gate(repo):
 
 def main():
     argv = sys.argv[1:]
+    if "--fleet" in argv:
+        # the multi-process fleet drill (tools/fleet_drill.py):
+        # replica pool + shared store + kill -9, gated via FLEET.jsonl
+        from tools.fleet_drill import main as fleet_main
+        sys.argv = [sys.argv[0]]       # the drill reads env, not argv
+        fleet_main()
+        return
     if "--chaos" in argv:
         i = argv.index("--chaos")
         spec = (argv[i + 1] if i + 1 < len(argv)

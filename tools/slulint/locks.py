@@ -44,7 +44,8 @@ RULE_JOIN_LOCK = "join-under-lock"
 # package files in the audited set (repo-relative prefixes/paths)
 AUDIT_PREFIXES = ("superlu_dist_tpu/serve/",
                   "superlu_dist_tpu/resilience/",
-                  "superlu_dist_tpu/obs/")
+                  "superlu_dist_tpu/obs/",
+                  "superlu_dist_tpu/fleet/")
 AUDIT_FILES = ("superlu_dist_tpu/utils/warmup.py",)
 
 

@@ -126,6 +126,17 @@ SLU_SMOKE_CHECK_TIMEOUT=${SLU_SMOKE_CHECK_TIMEOUT:-240} \
   timeout 2100 python "$repo/tools/tpu_smoke.py" > "$smoke_out" 2>> "$log"
 stamp "smoke rc=$? -> $smoke_out"
 
+# 3b. Fleet drill — the multi-process resilience gate (>=3 replica
+#     processes on one shared store, chaos load, kill -9 mid-load;
+#     tools/fleet_drill.py appends to FLEET.jsonl and fails on any
+#     lost/hung request, a stampeded cold key, or a survivor that
+#     re-factored instead of adopting warm).  Pure-coordination
+#     (host-backend replicas, no device work), so it runs in the
+#     dryrun too and never spends tunnel time; SLU_REGRESS=0 here
+#     because the full sentinel runs at the end of the plan.
+SLU_REGRESS=0 timeout 600 python -m tools.fleet_drill >> "$log" 2>&1
+stamp "fleet drill rc=$?"
+
 # Everything below step 3 runs on hardware only: the sweep's scale
 # configs compile for many minutes even staged.  The CPU rehearsal's
 # budget claim is steps 1 and 3 (bench + smoke; step 2's profile is

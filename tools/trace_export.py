@@ -65,14 +65,40 @@ def is_flight_record(obj) -> bool:
             and isinstance(obj.get("events"), list))
 
 
+# replicas are spaced at least this far apart in the pid namespace:
+# a fleet trace (N replicas appending to one SLU_FLIGHT_JSONL) groups
+# per-replica — pids cluster by replica, and a rid that collides
+# across replicas (per-process counters both start at 1) still maps
+# to a distinct track.  The actual stride grows past the log's
+# largest rid so a long-running replica can never wrap into its
+# neighbour's block.
+_REPLICA_PID_STRIDE = 1_000_000
+
+
 def flight_to_chrome(records: list) -> list:
     """Flight records -> per-request Chrome tracks: one pid per
     request, named by rid and outcome; tid 0 carries the request's
     e2e span, tid 1 the stage events (spans where the event carries
     its own duration — queue wait, solve — instants otherwise).
-    Raises ValueError on a malformed record (same CLI hygiene as the
-    span-JSONL path)."""
+    A MERGED fleet log (records from two or more replicas, each
+    carrying the `replica` id obs/flight.py stamps) is GROUPED per
+    replica: each replica gets its own pid block, so colliding
+    per-process rids render one track per (replica, rid), named by
+    both.  Single-replica logs keep the historical pid == rid
+    mapping.  Raises ValueError on a malformed record (same CLI
+    hygiene as the span-JSONL path)."""
     events: list = []
+    replica_block: dict[str, int] = {}
+    fleet = len({str(r.get("replica")) for r in records
+                 if isinstance(r, dict) and r.get("replica")}) > 1
+    stride = _REPLICA_PID_STRIDE
+    if fleet:
+        max_rid = max((r["rid"] for r in records
+                       if isinstance(r, dict)
+                       and isinstance(r.get("rid"), int)),
+                      default=0)
+        while stride <= max_rid:
+            stride *= 10
     for i, rec in enumerate(records):
         if not is_flight_record(rec):
             raise ValueError(f"record {i} is not a flight record: "
@@ -84,7 +110,15 @@ def flight_to_chrome(records: list) -> list:
         if not isinstance(t0, (int, float)):
             raise ValueError(f"record {i} t0_us not numeric")
         outcome = rec.get("outcome") or "?"
-        name = f"request {rid} [{outcome}]"
+        replica = rec.get("replica")
+        if fleet and replica:
+            block = replica_block.setdefault(
+                str(replica), len(replica_block))
+            rid = (block + 1) * stride + rid
+            name = (f"replica {replica} request {rec['rid']} "
+                    f"[{outcome}]")
+        else:
+            name = f"request {rid} [{outcome}]"
         if rec.get("failed_stage"):
             name += f" @{rec['failed_stage']}"
         events.append({"name": "process_name", "ph": "M", "pid": rid,
