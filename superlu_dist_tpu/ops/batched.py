@@ -1295,7 +1295,8 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        axis: Optional[str] = None,
                        gather: bool = True, coop: bool = False,
                        ndev: int = 1, pos_idx=None, cp: int = 0,
-                       tp: int = 0, pair: bool = False):
+                       tp: int = 0, pair: bool = False,
+                       pallas_diag: bool = False):
     if pair:
         return _factor_group_impl_pair(
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
@@ -1347,7 +1348,12 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
         nzero_g = nzero_g * on_owner
         Lsrc, Usrc, upd_src = F[:, :, :wb], F[:, :wb, :], F[:, wb:, wb:]
     else:
-        F, tiny_g, nzero_g = partial_lu_batch(F, thresh, wb=wb)
+        # pallas_diag=True is the merged-factor-segment promotion of
+        # the Pallas panel-LU kernel (ops/pallas_lu.merged_eligible):
+        # the caller resolved eligibility per member bucket, so this
+        # call routes through the kernel unconditionally-if-available
+        F, tiny_g, nzero_g = partial_lu_batch(
+            F, thresh, wb=wb, pallas=True if pallas_diag else None)
         Lsrc, Usrc, upd_src = F[:, :, :wb], F[:, :wb, :], F[:, wb:, wb:]
 
     rows = jnp.arange(mb)[:, None]
@@ -1679,6 +1685,156 @@ def staged_enabled(sched) -> bool:
     return len(sched.groups) > thresh
 
 
+# --------------------------------------------------------------------
+# level-merged factor segments (ISSUE 12): the PR 7 trisolve merge
+# discipline (SLU_TRISOLVE_MERGE_CELLS) applied to the factor sweep.
+# The staged factor dispatch pays ~one Python dispatch per group; the
+# deep narrow chain tail of an elimination tree is hundreds of SMALL
+# groups whose device bodies are µs-scale, so the sweep is
+# dispatch-latency-bound exactly like the nrhs=1 solve was.  Chains
+# of small consecutive groups coalesce into ONE donated-buffer
+# dispatch unit (`_staged_factor_segment`): the extend-add slab
+# streams through the segment in place, the member bodies are
+# literally `_factor_group_impl` in schedule order — so the merged
+# sweep is bitwise-identical to the per-group dispatch by
+# construction (pinned at fp64 in tests/test_factor_merge.py) — and
+# the per-segment programs are warmed/persisted exactly like the
+# solve segments (utils/warmup.staged_signatures).
+# --------------------------------------------------------------------
+
+FACTOR_MERGE_CELLS_DEFAULT = 65536
+
+
+def factor_merge_cells() -> int:
+    """A factor group whose front-cell count (n_loc · mb · ncols) is
+    at or below this joins a merged staged dispatch segment
+    (SLU_FACTOR_MERGE_CELLS, default 65536 — the trisolve merge
+    bound's sibling): small enough that the group body is
+    dispatch-dominated.  0 restores the legacy per-group staged
+    dispatch (the A/B arm)."""
+    try:
+        return max(0, flags.env_int("SLU_FACTOR_MERGE_CELLS",
+                                    FACTOR_MERGE_CELLS_DEFAULT))
+    except ValueError:
+        return FACTOR_MERGE_CELLS_DEFAULT
+
+
+def factor_seg_cells() -> int:
+    """Total front-cell budget of one merged factor segment
+    (SLU_FACTOR_SEG_CELLS, default 1048576): bounds per-segment
+    program size so segment compiles stay in the per-group compile
+    class (the SLU_TRISOLVE_SEG_CELLS sibling)."""
+    try:
+        return max(1, flags.env_int("SLU_FACTOR_SEG_CELLS", 1048576))
+    except ValueError:
+        return 1048576
+
+
+def factor_merge_on() -> bool:
+    return factor_merge_cells() > 0
+
+
+def compute_factor_segments(sched, cells: int | None = None,
+                            cap: int | None = None) -> list:
+    """Group indices per merged dispatch segment, in schedule order
+    (the trisolve segment pass, build_trisolve, applied to the factor
+    sweep's cost model): groups at or below the `cells` bound chain
+    into the open segment until `cap`; a large group stands alone —
+    its LU/GEMM body is real work and chaining it buys nothing."""
+    cells = factor_merge_cells() if cells is None else cells
+    cap = factor_seg_cells() if cap is None else cap
+    segments: list = []
+    cur: list = []
+    cur_cells = 0
+    for gi, g in enumerate(sched.groups):
+        ncols = g.cp if g.cp > 0 else g.mb
+        c = g.n_loc * g.mb * ncols
+        small = c <= cells
+        if cur and ((not small) or cur_cells + c > cap):
+            segments.append(cur)
+            cur, cur_cells = [], 0
+        cur.append(gi)
+        cur_cells += c
+        if not small:
+            segments.append(cur)
+            cur, cur_cells = [], 0
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def get_factor_segments(sched) -> list:
+    """Cached factor segments for a schedule, keyed by the merge
+    knobs (a mid-process flag change rebuilds instead of hitting a
+    stale layout)."""
+    cache = getattr(sched, "_factor_segments", None)
+    if cache is None:
+        cache = sched._factor_segments = {}
+    key = (factor_merge_cells(), factor_seg_cells())
+    if key not in cache:
+        cache[key] = compute_factor_segments(sched)
+    return cache[key]
+
+
+def factor_seg_metas(sched, members, dtype) -> tuple:
+    """The static meta tuple of one merged factor segment's members,
+    in schedule order — THE single definition of the segment jit's
+    static key, shared by the dispatch site (_staged_factor_run) and
+    the AOT warmup (utils/warmup.py): a drift between the two would
+    turn warmed programs into dead compiles (the trisolve seg_metas
+    contract).  The last leg is the per-member Pallas panel-LU
+    promotion decision (ops/pallas_lu.merged_eligible) — it shapes
+    the program, so it keys the cache."""
+    from . import pallas_lu
+    dtype = np.dtype(dtype)
+    rdt = _real_dtype(dtype)
+    return tuple(
+        (sched.groups[i].mb, sched.groups[i].wb,
+         sched.groups[i].n_loc, sched.groups[i].ea_meta,
+         sched.groups[i].eb_meta,
+         bool(pallas_lu.merged_eligible(
+             sched.groups[i].wb, sched.groups[i].mb, rdt)))
+        for i in members)
+
+
+def factor_arm(sched=None, dtype=None) -> str:
+    """One-token description of the factor-sweep arm —
+    legacy|merged|merged+pallas — stamped onto factor-timing records
+    (SOLVE_LATENCY.jsonl) and read back by
+    serve/errors.factor_cost_hint_s so fleet lease TTLs track the
+    ACTIVE arm's measured cost (the trisolve active_arm sibling).
+    With a (schedule, dtype) the "+pallas" suffix is claimed only
+    when some merged segment member actually routes through the
+    kernel; without one it falls back to the env resolution.
+    Complex dtypes always report "legacy": their staged dispatch
+    stays per-group (see _staged_factor_run — claiming merged there
+    would be exactly the misattribution the arm field exists to
+    prevent)."""
+    if not factor_merge_on():
+        return "legacy"
+    from . import pallas_lu
+    if dtype is not None and np.dtype(dtype).kind == "c":
+        return "legacy"
+    if sched is not None and dtype is not None:
+        rdt = _real_dtype(np.dtype(dtype))
+        if any(pallas_lu.merged_eligible(sched.groups[i].wb,
+                                         sched.groups[i].mb, rdt)
+               for seg in get_factor_segments(sched) for i in seg):
+            return "merged+pallas"
+        return "merged"
+    # schedule-less fallback mirrors pallas_lu.merged_eligible's
+    # resolution (unset == "auto" -> kernel on real TPU): the arm the
+    # serve layer reports must agree with the arm records are stamped
+    # with, or TTL hints chase the wrong history
+    flag = flags.env_str("SLU_TPU_PALLAS", "auto").strip().lower()
+    if pallas_lu.kernel_available(np.float32) and (
+            flag == "1"
+            or (flag not in ("0", "false", "off")
+                and jax.default_backend() == "tpu")):
+        return "merged+pallas"
+    return "merged"
+
+
 @functools.partial(jax.jit,
                    static_argnames=("mb", "wb", "n_pad", "ea_meta",
                                     "eb_meta", "pair"),
@@ -1705,6 +1861,47 @@ def _staged_factor_group(upd_buf, vals, thresh, a_src, a_dst, one_dst,
             upd_off, z32, z32, z32, z32,
             mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
             eb_meta=eb_meta, pair=pair)
+
+
+@functools.partial(jax.jit, static_argnames=("metas", "pair"),
+                   donate_argnums=(0,))
+def _staged_factor_segment(upd_buf, vals, thresh, a_srcs, a_dsts,
+                           one_dsts, ea_blockss, upd_offs, *, metas,
+                           pair: bool = False):
+    """One merged factor segment as a single program: `metas` is the
+    static tuple from factor_seg_metas — (mb, wb, n_pad, ea_meta,
+    eb_meta, use_pallas) per member — so a segment signature compiles
+    once and is shared by every factorization with the same layout.
+    `upd_buf` is donated and streams through the whole segment chain
+    in place (the _staged_factor_group discipline, now amortized over
+    the members); the member bodies run in exactly the order and with
+    exactly the operands of the per-group dispatch, so results are
+    bitwise-identical to it."""
+    dtype = upd_buf.dtype
+    lead = (2,) if pair else ()
+    z32 = jnp.zeros((), jnp.int32)
+    panels = []
+    tiny = nzero = z32
+    with jax.default_matmul_precision("float32"):
+        for ((mb, wb, n_pad, ea_meta, eb_meta, use_pallas), a_src,
+             a_dst, one_dst, ea_blocks, upd_off) in zip(
+                 metas, a_srcs, a_dsts, one_dsts, ea_blockss,
+                 upd_offs):
+            (upd_buf, L, U, Li, Ui, t, z) = _factor_group_impl(
+                vals, upd_buf,
+                jnp.zeros(lead + (n_pad * mb * wb,), dtype),
+                jnp.zeros(lead + (n_pad * wb * mb,), dtype),
+                jnp.zeros(lead + (n_pad * wb * wb,), dtype),
+                jnp.zeros(lead + (n_pad * wb * wb,), dtype),
+                z32, z32, thresh, a_src, a_dst, one_dst, ea_blocks,
+                upd_off, z32, z32, z32, z32,
+                mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
+                eb_meta=eb_meta, pair=pair,
+                pallas_diag=use_pallas)
+            panels.append((L, U, Li, Ui))
+            tiny = tiny + t
+            nzero = nzero + z
+    return upd_buf, tuple(panels), tiny, nzero
 
 
 @functools.partial(jax.jit,
@@ -1756,6 +1953,33 @@ def _staged_factor_run(sched, vals, thresh_np, dtype,
     thresh = jnp.asarray(thresh_np, dtype=rdt)
     panels = []
     tiny = nzero = jnp.zeros((), jnp.int32)
+    if factor_merge_on() and not pair and dtype.kind != "c":
+        # level-merged arm: one dispatch per SEGMENT (every segment,
+        # singletons included, so the dispatched program set is
+        # exactly what warmup_staged compiled); panels flatten back
+        # to the per-group list every consumer expects.  REAL dtypes
+        # only: complex multiplies re-associate when XLA:CPU fuses
+        # across group boundaries (measured ~1e-17 element drift vs
+        # the per-group dispatch — the same program-shape-sensitive
+        # complex lowering this platform is already documented for),
+        # so complex/pair lanes keep the proven per-group dispatch
+        # and the bitwise contract stays exact where it is pinned
+        # (real fp64, the PR 7 bar)
+        for seg in get_factor_segments(sched):
+            ops = [sched.groups[i].dev(squeeze=True)[:4]
+                   for i in seg]
+            (upd_buf, pseg, t, z) = _staged_factor_segment(
+                upd_buf, vals_ext, thresh,
+                tuple(o[0] for o in ops), tuple(o[1] for o in ops),
+                tuple(o[2] for o in ops), tuple(o[3] for o in ops),
+                tuple(jnp.asarray(sched.groups[i].upd_off_global,
+                                  jnp.int64) for i in seg),
+                metas=factor_seg_metas(sched, seg, dtype), pair=pair)
+            panels.extend(pseg)
+            tiny = tiny + t
+            nzero = nzero + z
+        del upd_buf
+        return panels, int(tiny), int(nzero)
     for g in sched.groups:
         a_src, a_dst, one_dst, ea_blocks = g.dev(squeeze=True)[:4]
         (upd_buf, L, U, Li, Ui, t, z) = _staged_factor_group(
@@ -1942,8 +2166,30 @@ def _phase_fns(sched, dtype, thresh_np, pair=None):
         # attribution — the recompile counter serve_bench pins its
         # zero-recompiles-after-warmup contract on.  The proxies
         # delegate lower()/_cache_size() to the jits underneath.
+        # With SLU_AOT_CACHE active the factor program is AOT-wrapped
+        # (resilience/aot.py): a fresh process deserializes the
+        # persisted export instead of re-tracing the whole-phase
+        # factor.  The solve twin keeps its plain jit here (static
+        # `trans` leg; the serve hot path's solve program is the
+        # packed one, AOT-wrapped in trisolve._solve_packed_fn) and
+        # rides the compilation-cache leg.
+        # Complex lanes are never AOT-wrapped: the complex-on-TPU
+        # platform gate (utils/platform.py) executes complex programs
+        # on the host CPU while the default backend stays TPU, and an
+        # export records ONE platform — the gated dispatch would be
+        # refused at call time.  Real dtypes always run on the
+        # backend they export for.
+        from ..resilience import aot
+        factor_w = factor_fn
+        if not pair and dtype.kind != "c":
+            factor_w = aot.wrap_jit(
+                "phase_factor", factor_fn,
+                aot.schedule_fingerprint(
+                    sched, dtype,
+                    extra=("phase_factor", bool(pair),
+                           float(thresh_np))))
         cache[key] = (
-            obs.watch_jit("factor", factor_fn, cost_phase="FACT"),
+            obs.watch_jit("factor", factor_w, cost_phase="FACT"),
             obs.watch_jit("solve", solve_fn, cost_phase="SOLVE"))
         return cache[key]
 
@@ -2883,3 +3129,65 @@ def make_fused_solver(plan: FactorPlan, dtype=np.float32,
     step.spmv_layout = layout
     step.residual_mode = mode
     return step
+
+
+# --------------------------------------------------------------------
+# HLO contract registry declarations (tools/slulint/contracts.py)
+# --------------------------------------------------------------------
+#
+# The merged factor segments' structural guarantees (ISSUE 12),
+# declared next to the code that earns them.  Donation is the
+# load-bearing one: the extend-add slab must stream through a
+# segment's member chain IN PLACE — a dropped donation silently
+# doubles the staged factor's slab traffic.  A factor program can
+# never be scatter-free (the A-assembly writes nnz values into the
+# front batch, and the ragged extend-add remainder accumulates by
+# scatter-add by design), so the scatter contract here pins the PR 1
+# promise discipline instead: the assembly scatters must keep their
+# sorted+unique parallel-lowering promises through the merged
+# segment lowering (DESIGN.md §19 records the no_scatter deviation).
+
+def _contract_build_factor_segment():
+    import jax
+
+    from ..options import Options
+    from ..plan.plan import plan_factorization
+    from ..utils.testmat import laplacian_3d
+    a = laplacian_3d(6)             # 7 groups -> one 7-member segment
+    plan = plan_factorization(a, Options(factor_dtype="float32"))
+    sched = get_schedule(plan, 1)
+    segs = get_factor_segments(sched)
+    seg = next((s for s in segs if len(s) > 1), segs[0])
+    dtype = np.dtype(np.float32)
+    ops = [sched.groups[i].dev(squeeze=True)[:4] for i in seg]
+
+    def sds(x):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+    args = (
+        jnp.zeros(sched.upd_total + sched.upd_pad, dtype),
+        jnp.zeros(len(plan.coo_rows) + 1, dtype),
+        jnp.zeros((), dtype),
+        tuple(o[0] for o in ops), tuple(o[1] for o in ops),
+        tuple(o[2] for o in ops), tuple(o[3] for o in ops),
+        tuple(jnp.asarray(sched.groups[i].upd_off_global, jnp.int64)
+              for i in seg),
+    )
+    return (_staged_factor_segment, args,
+            dict(metas=factor_seg_metas(sched, seg, dtype),
+                 pair=False))
+
+
+HLO_CONTRACTS = (
+    {"name": "factor.staged_segment",
+     "phase": "factor",
+     "env": {"SLU_FACTOR_MERGE_CELLS": "65536", "SLU_STAGED": "1"},
+     "contracts": ("donation_honored", "assembly_scatter_promised",
+                   "no_host_callback"),
+     "build": _contract_build_factor_segment,
+     "note": "the extend-add slab streams through the merged factor "
+             "segment's member chain in place, and the A-assembly "
+             "scatters keep their sorted+unique parallel-lowering "
+             "promises (a factor program cannot be scatter-free — "
+             "DESIGN.md §19)"},
+)

@@ -232,13 +232,20 @@ def partial_lu(F, thresh, *, wb: int, nb: int = 32):
     return F, tiny, nzero
 
 
-def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32):
+def partial_lu_batch(F, thresh, *, wb: int, nb: int = 32,
+                     pallas: bool | None = None):
     """vmapped partial_lu over a batch of fronts (N, mb, mb).
     Returns (F', tiny_count, zero_pivot_count).  Dispatches to the
-    VMEM-resident Pallas kernel when enabled (ops/pallas_lu.py)."""
+    VMEM-resident Pallas kernel when enabled (ops/pallas_lu.py).
+    `pallas` overrides the env-resolved routing: True routes this
+    call through the kernel when it is structurally available (the
+    merged factor segments' small-bucket promotion,
+    ops/batched.factor_seg_metas), False forces the XLA path, None
+    keeps the historical SLU_TPU_PALLAS resolution."""
     from . import pallas_lu
-    if pallas_lu.enabled(F.dtype) and pallas_lu.usable(F.shape[-1],
-                                                      F.dtype):
+    use = (pallas_lu.enabled(F.dtype) if pallas is None
+           else bool(pallas) and pallas_lu.kernel_available(F.dtype))
+    if use and pallas_lu.usable(F.shape[-1], F.dtype):
         return pallas_lu.partial_lu_batch_pallas(F, thresh, wb=wb)
     f = functools.partial(partial_lu, wb=wb, nb=nb)
     Fs, tinys, nzeros = jax.vmap(lambda x: f(x, thresh))(F)

@@ -55,19 +55,11 @@ except ImportError:  # pragma: no cover
         return contextlib.nullcontext()
 
 
-def enabled(dtype) -> bool:
-    """Use the Pallas kernel?  SLU_TPU_PALLAS=1 forces on (interpret
-    mode off-TPU), =0 forces off.
-
-    Default OFF — resolved by hardware measurement, not hope
-    (PALLAS_AB.json, tools/pallas_ab.py on TPU v5e, amortized in-jit
-    timing): the XLA fori_loop formulation is ~2x faster at every
-    bucket shape ≥ (wb=16, mb=32) (e.g. 44 vs 20 GFLOP/s at 512²) and
-    both paths sit at true-f32 accuracy vs the f64 ground truth
-    (~5e-7) under the package's "highest" matmul precision.  The
-    kernel wins only the µs-scale (8, 16) bucket (1.3x), which never
-    dominates a schedule.  Complex dtypes always use the XLA path (no
-    complex in Mosaic)."""
+def kernel_available(dtype) -> bool:
+    """Structural availability of the kernel for `dtype` — the
+    non-policy half of `enabled()`: pallas importable, the x64-off
+    tracing shim present when x64 is globally on, and a real sub-f64
+    dtype (no complex / no 64-bit in Mosaic)."""
     if not _HAVE_PALLAS:
         return False
     if not _HAVE_X64_CTX and jax.config.jax_enable_x64:
@@ -81,8 +73,48 @@ def enabled(dtype) -> bool:
         # f64: the kernel traces with x64 disabled and Mosaic has no
         # 64-bit lowering — always the XLA path
         return False
-    flag = flags.env_str("SLU_TPU_PALLAS", "0")
-    return flag == "1"
+    return True
+
+
+def enabled(dtype) -> bool:
+    """Use the Pallas kernel everywhere?  SLU_TPU_PALLAS=1 forces on
+    (interpret mode off-TPU), =0/unset leaves the global routing off.
+
+    Default OFF — resolved by hardware measurement, not hope
+    (PALLAS_AB.json, tools/pallas_ab.py on TPU v5e, amortized in-jit
+    timing): the XLA fori_loop formulation is ~2x faster at every
+    bucket shape ≥ (wb=16, mb=32) (e.g. 44 vs 20 GFLOP/s at 512²) and
+    both paths sit at true-f32 accuracy vs the f64 ground truth
+    (~5e-7) under the package's "highest" matmul precision.  The
+    kernel wins only the µs-scale (8, 16) bucket (1.3x), which never
+    dominates a schedule — but IS the population the level-merged
+    factor segments coalesce; `merged_eligible` promotes exactly that
+    regime.  Complex dtypes always use the XLA path (no complex in
+    Mosaic)."""
+    if not kernel_available(dtype):
+        return False
+    return flags.env_str("SLU_TPU_PALLAS", "0").strip() == "1"
+
+
+def merged_eligible(wb: int, mb: int, dtype) -> bool:
+    """Merged-factor-segment promotion (ISSUE 12): inside a merged
+    staged factor segment (ops/batched.get_factor_segments) the
+    panel-LU kernel engages BY DEFAULT for the µs-scale buckets the
+    fire-plan chain arms priced it ahead on — wb ≤ 8, mb ≤ 16, the
+    (8, 16)-class population that level merging coalesces — on real
+    TPU hardware only (kernels are resolved by measurement; interpret
+    mode would merely slow the CPU rehearsal, and the bitwise fp64
+    A/B never reaches here because f64 is structurally ineligible).
+    SLU_TPU_PALLAS=0 restores the XLA path; =1 forces the kernel for
+    every usable bucket (the historical A/B arm)."""
+    if not kernel_available(dtype) or not usable(mb, dtype):
+        return False
+    flag = flags.env_str("SLU_TPU_PALLAS", "auto").strip().lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu" and wb <= 8 and mb <= 16
 
 
 # the kernel keeps input+output front copies VMEM-resident (~16 MB/core

@@ -366,6 +366,20 @@ class PackSet(tuple):
         return cls(children)
 
 
+# PackSet appears in the packed solve program's argument pytree, so
+# jax.export must know how to serialize its (empty) auxdata for the
+# AOT-persistence leg (resilience/aot.py) — without this, exporting
+# the packed solve raises on the unregistered custom node
+try:
+    from jax import export as _jax_export
+    _jax_export.register_pytree_node_serialization(
+        PackSet, serialized_name="superlu_dist_tpu.trisolve.PackSet",
+        serialize_auxdata=lambda aux: b"",
+        deserialize_auxdata=lambda b: None)
+except Exception:                   # noqa: BLE001 — older jax or a
+    pass                            # re-registration; AOT then skips
+
+
 # reentrant: _solve_packed_fn/get_packs build the layout
 # (get_trisolve) while already holding the lock
 _build_lock = threading.RLock()
@@ -684,14 +698,30 @@ def _solve_packed_fn(sched, dtype, pair: bool):
         # `trans` kwarg: a static_argnames keyword call drops jax to
         # the slow python dispatch path — measured ~ms per call
         # against this fn's ~200-operand pack pytree, real money at
-        # the nrhs=1 solve scale
+        # the nrhs=1 solve scale.  With SLU_AOT_CACHE active the jit
+        # is AOT-wrapped (resilience/aot.py): per call signature the
+        # program deserializes from the persistent export instead of
+        # re-tracing — the serve hot path's cold-boot lever — with
+        # the compile-watch proxy outermost as always.
+        from ..resilience import aot
+
         def mk(trans):
             @jax.jit
             def solve_fn(packs, b):
                 with jax.default_matmul_precision("float32"):
                     return sweep(ts, packs, b, dtype, trans,
                                  pair=pair)
-            return obs.watch_jit("solve", solve_fn,
+            wrapped = solve_fn
+            if not pair and np.dtype(dtype).kind != "c":
+                # complex lanes skip AOT: the complex-on-TPU gate
+                # executes them on the host CPU under a TPU default
+                # backend, and an export records one platform (the
+                # batched._phase_fns note)
+                wrapped = aot.wrap_jit(
+                    f"solve_packed.{'T' if trans else 'N'}", solve_fn,
+                    aot.schedule_fingerprint(
+                        sched, dtype, extra=("packed", bool(pair))))
+            return obs.watch_jit("solve", wrapped,
                                  cost_phase="SOLVE")
 
         cache[key] = (mk(False), mk(True))

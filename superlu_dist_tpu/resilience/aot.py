@@ -1,0 +1,414 @@
+"""AOT executable persistence — compiled whole-phase programs as
+durable artifacts (ISSUE 12, ROADMAP item 1a).
+
+The durable factor store (resilience/store.py) lets a fresh replica
+skip the FACTORIZATION; until this module nothing let it skip the
+COMPILATION: a genuinely fresh process re-paid 14–33 s of jit
+trace/lower warmup plus a 2m4s whole-phase XLA:CPU compile (BENCH_r05)
+before serving its first solve.  With static pivoting both costs are
+cacheable artifacts — the task graph is fixed at plan time, so the
+whole-phase programs are pure functions of (schedule layout, dtype,
+merge flags) — and this module persists them on two legs:
+
+  * **export leg** (this module): whole-phase jits serialize via
+    `jax.export` — the StableHLO module plus calling convention —
+    keyed by `schedule_fingerprint` (per-group layout + dtype + the
+    factor/trisolve merge-flag surface + jax version + backend).  A
+    fresh process DESERIALIZES instead of re-tracing: the 14–33 s
+    Python trace/lower wall collapses to a read.  Integration sites:
+    `ops/batched._phase_fns` (whole-phase factor) and
+    `ops/trisolve._solve_packed_fn` (the packed solve — the serve hot
+    path), via `wrap_jit`'s per-signature read-through/write-through
+    proxy.  Producer and consumer both dispatch through the SAME
+    exported module (`jax.jit(exported.call)`), so the two can never
+    execute divergent programs.
+  * **compilation-cache leg**: the deserialized module still needs a
+    backend compile — `ensure_xla_cache` points jax's persistent
+    compilation cache at `<dir>/xla` when none is configured, so that
+    compile is a disk hit across processes.  The staged per-segment
+    programs (factor segments + trisolve segments) ride this leg
+    alone: they are bounded per-segment compiles with donated
+    operands, already warmed/persisted by `utils/warmup.py` — the
+    "pinned reliance on the compilation cache" fallback the flags
+    table documents.
+
+Storage discipline follows the factor store: atomic-rename writes
+(`utils/io.atomic_write_bytes`), a sha256 frame over the payload, and
+a header echoing the fingerprint.  The loader REFUSES any mismatch —
+frame, fingerprint, jax version, undeserializable payload — with the
+typed `AotMismatch` and quarantines the entry (*.quarantined, the
+store convention): a stale or corrupt executable is never dispatched.
+`tools/serve_bench.py --cold-boot` is the drill: a second fresh
+process against a warm store + AOT cache must serve with
+factorizations == 0 AND aot misses == 0 (gated in tools/regress.py).
+
+Off (`SLU_AOT_CACHE` unset/0) this module costs one string check per
+program build — nothing on the dispatch path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+import numpy as np
+
+from .. import flags
+from ..utils.io import atomic_write_bytes
+
+_MAGIC = b"SLUAOT1\n"
+SUFFIX = ".aot"
+
+
+class AotMismatch(RuntimeError):
+    """A persisted AOT entry failed verification (sha256 frame,
+    header, fingerprint echo, jax version, deserialization): the
+    loader refuses to dispatch it — typed so callers can tell a
+    refused artifact from a plain miss — and the entry is quarantined
+    so the next boot re-exports a fresh one."""
+
+
+# --------------------------------------------------------------------
+# configuration
+# --------------------------------------------------------------------
+
+def aot_dir() -> str | None:
+    """The AOT cache directory (SLU_AOT_CACHE), or None when the
+    feature is off (unset / '0' / 'off')."""
+    v = flags.env_str("SLU_AOT_CACHE", "").strip()
+    if not v or v.lower() in ("0", "off", "false"):
+        return None
+    return v
+
+
+def enabled() -> bool:
+    return aot_dir() is not None
+
+
+_xla_wired = False
+
+
+def ensure_xla_cache() -> None:
+    """The compilation-cache leg: when the AOT dir is active and no
+    persistent compile cache is configured (jax config or
+    JAX_COMPILATION_CACHE_DIR), point jax at `<dir>/xla` so the
+    deserialized programs' backend compiles — and the staged
+    per-segment programs, which ride this leg alone — hit disk
+    across processes."""
+    global _xla_wired
+    d = aot_dir()
+    if d is None or _xla_wired:
+        return
+    _xla_wired = True
+    import jax
+    if (jax.config.jax_compilation_cache_dir
+            or flags.env_opt("JAX_COMPILATION_CACHE_DIR")):
+        return                      # an explicit cache wins
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(d, "xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1)
+    except Exception:               # noqa: BLE001 — optional leg; the
+        pass                        # export leg still works without it
+
+
+# --------------------------------------------------------------------
+# counters (the cold-boot drill's gate reads these)
+# --------------------------------------------------------------------
+
+_stats_lock = threading.Lock()
+_STATS = {"hits": 0, "misses": 0, "saves": 0, "rejected": 0}
+
+
+def _inc(k: str) -> None:
+    with _stats_lock:
+        _STATS[k] += 1
+
+
+def stats() -> dict:
+    """{'hits', 'misses', 'saves', 'rejected'} — hits = programs
+    served from a deserialized export, misses = absent entries
+    (trace+export paid), rejected = entries refused by verification
+    (quarantined, then re-exported)."""
+    with _stats_lock:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _stats_lock:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+# --------------------------------------------------------------------
+# fingerprint
+# --------------------------------------------------------------------
+
+def _pattern_sig(sched) -> str:
+    """sha256 over the schedule's INDEX CONTENT — the assembly maps,
+    extend-add records and solve gather layouts the whole-phase
+    programs bake in as constants.  Extents alone are not identity:
+    two different sparsity patterns can share every per-group extent
+    while their baked index arrays differ, and a fingerprint collision
+    would silently dispatch the wrong program — exactly the failure
+    the loader's refusal discipline exists to prevent.  Cached on the
+    schedule (one pass over the index bytes, the factor store's
+    checksum cost class)."""
+    sig = getattr(sched, "_aot_pattern_sig", None)
+    if sig is None:
+        h = hashlib.sha256()
+        for g in sched.groups:
+            for arr in (g.a_src, g.a_dst, g.one_dst, g.col_idx,
+                        g.struct_idx):
+                a = np.ascontiguousarray(np.asarray(arr))
+                h.update(repr((a.shape, a.dtype.str)).encode())
+                h.update(a.tobytes())
+            for host in (g.ea_hosts, g.eb_hosts):
+                for rec in host:
+                    for a in rec:
+                        a = np.ascontiguousarray(np.asarray(a))
+                        h.update(a.tobytes())
+            h.update(repr(int(g.upd_off_global)).encode())
+        sig = sched._aot_pattern_sig = h.hexdigest()
+    return sig
+
+
+def schedule_fingerprint(sched, dtype, extra=()) -> str:
+    """sha256 over everything that shapes a whole-phase program for
+    `sched`: the per-group layout (extents AND index content — the
+    programs bake the index arrays in as constants, see
+    _pattern_sig), dtype, the merge-flag surface (factor + trisolve
+    arms — a flag flip changes the program, so it must change the
+    key), jax version and backend.  `extra` appends caller legs
+    (e.g. the packed-solve pair flag)."""
+    import jax
+
+    from ..ops import batched as B
+    from ..ops import trisolve as T
+    parts = (
+        "v2", jax.__version__, jax.default_backend(),
+        _pattern_sig(sched),
+        np.dtype(dtype).str,
+        int(sched.n), int(sched.ndev), int(sched.upd_total),
+        int(getattr(sched, "upd_pad", 0)),
+        int(sched.L_total), int(sched.U_total),
+        int(sched.Li_total), int(sched.Ui_total),
+        tuple((int(g.mb), int(g.wb), int(g.n_loc), int(g.level))
+              for g in sched.groups),
+        B.factor_merge_cells(), B.factor_seg_cells(),
+        T.trisolve_mode(), T.merge_cells_limit(), T.seg_cells_limit(),
+        flags.env_str("SLU_TRISOLVE_PALLAS", "0"),
+        flags.env_str("SLU_TPU_PALLAS", "0"),
+        tuple(extra),
+    )
+    return hashlib.sha256(repr(parts).encode()).hexdigest()
+
+
+# --------------------------------------------------------------------
+# save / load
+# --------------------------------------------------------------------
+
+def _entry_path(name: str, fp: str) -> str | None:
+    d = aot_dir()
+    if d is None:
+        return None
+    os.makedirs(d, exist_ok=True)
+    safe = "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                   for ch in name)
+    return os.path.join(d, f"{safe}.{fp[:16]}{SUFFIX}")
+
+
+def quarantine(path: str, reason: str = "") -> None:
+    """Move a refused entry aside (the store convention): it is never
+    dispatched again, and the evidence survives for inspection."""
+    try:
+        os.replace(path, path + ".quarantined")
+    except OSError:
+        pass                        # a racer already moved/removed it
+
+
+def save(name: str, fp: str, exported) -> str | None:
+    """Write-through one serialized export atomically; returns the
+    path, or None when the feature is off."""
+    path = _entry_path(name, fp)
+    if path is None:
+        return None
+    import jax
+    payload = exported.serialize()
+    header = json.dumps(
+        {"format": 1, "name": name, "fingerprint": fp,
+         "jax": jax.__version__,
+         "platforms": list(exported.platforms)},
+        sort_keys=True).encode()
+    blob = header + b"\n" + payload
+    atomic_write_bytes(path, _MAGIC + hashlib.sha256(blob).digest()
+                       + blob)
+    _inc("saves")
+    return path
+
+
+def load(name: str, fp: str):
+    """Read-through lookup: the deserialized `jax.export.Exported`,
+    or None on plain absence.  ANY verification failure — bad frame,
+    fingerprint mismatch, jax-version drift, undeserializable payload
+    — raises the typed AotMismatch after quarantining the entry: a
+    questionable executable is refused, never dispatched."""
+    path = _entry_path(name, fp)
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        _inc("misses")
+        return None
+    import jax
+    try:
+        if not data.startswith(_MAGIC):
+            raise AotMismatch(f"{path}: bad magic")
+        digest = data[len(_MAGIC):len(_MAGIC) + 32]
+        blob = data[len(_MAGIC) + 32:]
+        if hashlib.sha256(blob).digest() != digest:
+            raise AotMismatch(f"{path}: sha256 frame mismatch")
+        head, sep, payload = blob.partition(b"\n")
+        if not sep:
+            raise AotMismatch(f"{path}: truncated header")
+        try:
+            meta = json.loads(head)
+        except ValueError as e:
+            raise AotMismatch(f"{path}: corrupt header: {e}")
+        if meta.get("fingerprint") != fp:
+            raise AotMismatch(
+                f"{path}: fingerprint mismatch — entry was exported "
+                "for a different (layout, dtype, merge-flag) world "
+                f"({str(meta.get('fingerprint'))[:16]}… != "
+                f"{fp[:16]}…)")
+        if meta.get("jax") != jax.__version__:
+            raise AotMismatch(
+                f"{path}: exported under jax {meta.get('jax')}, "
+                f"running {jax.__version__}")
+        try:
+            exported = jax.export.deserialize(payload)
+        except Exception as e:      # noqa: BLE001 — any deserializer
+            raise AotMismatch(      # failure is a refusal, not a crash
+                f"{path}: deserialize failed: {type(e).__name__}: {e}")
+    except AotMismatch:
+        _inc("rejected")
+        quarantine(path)
+        raise
+    _inc("hits")
+    return exported
+
+
+# --------------------------------------------------------------------
+# the per-signature jit proxy
+# --------------------------------------------------------------------
+
+class AotJit:
+    """Per-signature AOT-backed dispatch proxy over a jit: on each
+    NEW call signature it read-throughs the cache (deserialized
+    export → `jax.jit(exported.call)`) and on a miss exports the
+    underlying jit ONCE at those avals, write-throughs, and
+    dispatches through the same exported module — producer and
+    consumer execute identical programs by construction.  `lower` and
+    other attributes delegate to the wrapped jit (the compile-watch
+    and HLO-pin contract); `_cache_size` sums the per-signature jits
+    so the serve zero-recompile probes keep working."""
+
+    def __init__(self, name: str, fn, fingerprint: str):
+        self._name = name
+        self._fn = fn
+        self._fp = fingerprint
+        self._table: dict = {}
+        self._tlock = threading.Lock()
+
+    @staticmethod
+    def _sig_key(args):
+        # compile_watch._leaf_sig: (shape, dtype) for array-likes,
+        # recursion for list/tuple containers, repr for statics —
+        # and it memoizes container signatures ON attribute-capable
+        # containers (trisolve.PackSet).  Reusing it here means the
+        # ~200-leaf packed-solve signature is built once per PackSet
+        # (shared with the compile-watch proxy's own memo) instead of
+        # tree_flatten'd on every dispatch — the same 0.65 ms/call
+        # class the PR 7 signature memo removed from the hot path.
+        from ..obs.compile_watch import _leaf_sig
+        return tuple(_leaf_sig(a) for a in args)
+
+    def __call__(self, *args):
+        key = self._sig_key(args)
+        fn = self._table.get(key)   # GIL-atomic hot-path read
+        if fn is None:
+            fn = self._resolve(key, args)
+        try:
+            return fn(*args)
+        except ValueError as e:
+            if (fn is not self._fn
+                    and "was exported for platforms" in str(e)):
+                # an execution context placed the call on a platform
+                # the export does not cover (e.g. an explicit
+                # default_device override): fall back to the plain
+                # jit for this signature — correct beats cached
+                with self._tlock:
+                    self._table[key] = self._fn
+                return self._fn(*args)
+            raise
+
+    def _resolve(self, key, args):
+        with self._tlock:
+            fn = self._table.get(key)
+            if fn is not None:
+                return fn
+            import jax
+            from jax import export as jax_export
+            ename = (f"{self._name}.sig"
+                     + hashlib.sha256(repr(key).encode())
+                     .hexdigest()[:12])
+            try:
+                exp = load(ename, self._fp)
+            except AotMismatch:
+                exp = None          # refused + quarantined; re-export
+            if exp is None:
+                avals = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(tuple(x.shape),
+                                                   x.dtype)
+                    if hasattr(x, "shape") and hasattr(x, "dtype")
+                    else x, args)
+                try:
+                    exp = jax_export.export(self._fn)(*avals)
+                    save(ename, self._fp, exp)
+                except Exception:   # noqa: BLE001 — an unexportable
+                    # program (exotic pytree/op) must never break the
+                    # dispatch: fall back to the plain jit for this
+                    # signature; the entry simply never persists
+                    self._table[key] = self._fn
+                    return self._fn
+            fn = jax.jit(exp.call)
+            self._table[key] = fn
+            return fn
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        # dedupe by identity: every export-failure fallback signature
+        # stores the SAME underlying jit, and summing it once per
+        # entry would inflate the serve zero-recompile probes
+        seen = {id(f): f for f in self._table.values()}
+        return sum(int(f._cache_size()) for f in seen.values())
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def wrap_jit(name: str, fn, fingerprint: str):
+    """AOT-wrap `fn` when the cache is enabled (also wiring the
+    compilation-cache leg), else return it unchanged — the one-line
+    integration hook `_phase_fns` / `_solve_packed_fn` call."""
+    if not enabled():
+        return fn
+    ensure_xla_cache()
+    return AotJit(name, fn, fingerprint)

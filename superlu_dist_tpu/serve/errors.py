@@ -71,18 +71,28 @@ class DegradedResult(np.ndarray):
     healthy solve."""
 
 
-@functools.lru_cache(maxsize=1)
-def factor_cost_hint_s() -> float | None:
-    """The latest measured cold-factorization wall (seconds) from
-    SOLVE_LATENCY.jsonl, or None when no record exists.  The numeric
-    twin of factor_cost_hint(): fleet/lease.py sizes its lease TTL
-    off this figure — a lease must outlive the factorization it
-    guards, and the measured trajectory is the only honest estimate
-    of that."""
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "SOLVE_LATENCY.jsonl")
-    last_t = None
+def _record_factor_arm(rec: dict) -> str | None:
+    """The factor arm a t_factor_s record was measured under
+    (`factor_arm`, stamped by bench.py --solve-sweep); None for
+    pre-ISSUE-12 history."""
+    fa = rec.get("factor_arm")
+    return str(fa) if fa else None
+
+
+@functools.lru_cache(maxsize=8)
+def _factor_cost_from(path: str, arm: str | None) -> float | None:
+    """Latest t_factor_s in `path`, preferring the freshest record
+    measured under `arm`; falls back to the freshest record of any
+    arm (pre-arm history, or an arm with no record yet).
+
+    mode="factor_ab" rows are EXCLUDED: their t_factor_s is a WARM
+    in-process numeric-sweep timing (best-of interleaved passes,
+    compile and planning excluded — the A/B isolates the dispatch
+    lever), while this hint estimates the COLD wall a fleet lease
+    must outlive — plan build + compile-or-deserialize + the sweep.
+    Adopting the warm figure would collapse lease TTLs ~170x below
+    the cost they guard and invite mid-factorization lease steals."""
+    last_any = last_arm = None
     try:
         with open(path) as f:
             for line in f:
@@ -90,12 +100,43 @@ def factor_cost_hint_s() -> float | None:
                     rec = json.loads(line)
                 except ValueError:
                     continue
+                if rec.get("mode") == "factor_ab":
+                    continue
                 t = rec.get("t_factor_s")
-                if t:
-                    last_t = float(t)
+                if not t:
+                    continue
+                last_any = float(t)
+                if arm is not None and _record_factor_arm(rec) == arm:
+                    last_arm = float(t)
     except OSError:
         pass
-    return last_t
+    return last_arm if last_arm is not None else last_any
+
+
+def factor_cost_hint_s(arm: str | None = None) -> float | None:
+    """The latest measured cold-factorization wall (seconds) from
+    SOLVE_LATENCY.jsonl, or None when no record exists.  The numeric
+    twin of factor_cost_hint(): fleet/lease.py sizes its lease TTL
+    off this figure — a lease must outlive the factorization it
+    guards, and the measured trajectory is the only honest estimate
+    of that.
+
+    Arm-aware (ISSUE 12): with `arm` unset it resolves the ACTIVE
+    factor arm (ops/batched.factor_arm — legacy|merged|merged+pallas)
+    and prefers the freshest record measured under it, so a merged-arm
+    speedup SHRINKS lease TTLs instead of inheriting legacy-arm costs
+    (and an arm rollback re-inherits the honest slower figure)."""
+    if arm is None:
+        try:
+            from ..ops.batched import factor_arm
+            arm = factor_arm()
+        except Exception:           # noqa: BLE001 — hint, not gate:
+            arm = None              # any resolution failure degrades
+                                    # to the arm-less freshest record
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "SOLVE_LATENCY.jsonl")
+    return _factor_cost_from(path, arm)
 
 
 @functools.lru_cache(maxsize=1)

@@ -55,11 +55,15 @@ import numpy as np
 _LOWER_LOCK = threading.Lock()
 
 
-def staged_signatures(sched):
+def staged_signatures(sched, dtype="float32"):
     """The distinct (static-args + operand-aval) signatures of the
     staged factor and sweep programs — what the jit executable cache
     is actually keyed by.  Returns (factor_sigs, sweep_sigs) dicts
-    mapping signature -> a representative GroupSpec."""
+    mapping signature -> a representative GroupSpec (or a segment
+    index under the merged arms).  `dtype` is the FACTOR dtype the
+    dispatch will use: complex factorizations keep the per-group
+    dispatch (batched._staged_factor_run), so their factor keys stay
+    per-group even when the merged arm is on."""
     import jax
 
     def aval(x):
@@ -67,18 +71,40 @@ def staged_signatures(sched):
         # device index array to the host just to read metadata
         return (tuple(x.shape), str(x.dtype))
 
+    def ea_avals_of(ea_blocks):
+        return tuple(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(
+                aval, ea_blocks, is_leaf=lambda x: hasattr(x, "dtype"))))
+
     fsigs, ssigs = {}, {}
     for g in sched.groups:
         a_src, a_dst, one_dst, ea_blocks, _pos, ci, si = \
             g.dev(squeeze=True)
-        ea_avals = tuple(jax.tree_util.tree_leaves(
-            jax.tree_util.tree_map(
-                aval, ea_blocks, is_leaf=lambda x: hasattr(x, "dtype"))))
         fkey = (g.mb, g.wb, g.n_loc, g.ea_meta, g.eb_meta,
-                aval(a_src), aval(a_dst), aval(one_dst), ea_avals)
+                aval(a_src), aval(a_dst), aval(one_dst),
+                ea_avals_of(ea_blocks))
         fsigs.setdefault(fkey, g)
         skey = (g.mb, g.wb, g.n_loc, aval(ci), aval(si))
         ssigs.setdefault(skey, g)
+    from ..ops import batched as B
+    if B.factor_merge_on() and np.dtype(dtype).kind != "c":
+        # the level-merged factor arm dispatches one program per
+        # SEGMENT (batched._staged_factor_segment) — warm THOSE, not
+        # the legacy per-group factor programs.  The static half of
+        # the key is the shared factor_seg_metas definition (pallas
+        # promotion included, resolved for float32 — uniform across a
+        # warmup pass like the sweeps' cplx leg); the operand half is
+        # the member avals in order.
+        fsigs = {}
+        for seg_i, seg in enumerate(B.get_factor_segments(sched)):
+            opnd = tuple(
+                (aval(t[0]), aval(t[1]), aval(t[2]),
+                 ea_avals_of(t[3]))
+                for t in (sched.groups[i].dev(squeeze=True)[:4]
+                          for i in seg))
+            fsigs.setdefault(
+                (B.factor_seg_metas(sched, seg, np.float32), opnd),
+                seg_i)
     from ..ops import trisolve as T
     if T.trisolve_mode() == "merged":
         # the merged arm dispatches one program per SEGMENT
@@ -136,7 +162,7 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
             "configured (jax_compilation_cache_dir) — the warmed "
             "programs cannot be reused by the subsequent dispatch.",
             stacklevel=2)
-    fsigs, ssigs = staged_signatures(sched)
+    fsigs, ssigs = staged_signatures(sched, dtype)
     workers = workers or min(8, os.cpu_count() or 1)
 
     def sds(x):
@@ -156,6 +182,32 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
                 jax.ShapeDtypeStruct((), np.int64),
                 mb=mb, wb=wb, n_pad=n_pad, ea_meta=ea_meta,
                 eb_meta=eb_meta)
+        lowered.compile()
+
+    # merged-factor-arm warmup: one program per merged SEGMENT
+    # (batched._staged_factor_segment), operands mirrored exactly —
+    # member operand avals in schedule order, metas from the shared
+    # factor_seg_metas definition resolved at the WARM dtype (the
+    # pallas-promotion leg is dtype-dependent)
+    merged_factor = B.factor_merge_on() and dtype.kind != "c"
+
+    def compile_factor_seg(item):
+        _key, seg_i = item
+        seg = B.get_factor_segments(sched)[seg_i]
+        ops = [sched.groups[i].dev(squeeze=True)[:4] for i in seg]
+        with _LOWER_LOCK:
+            lowered = B._staged_factor_segment.lower(
+                jax.ShapeDtypeStruct(
+                    (sched.upd_total + sched.upd_pad,), dtype),
+                jax.ShapeDtypeStruct((len(plan.coo_rows) + 1,), dtype),
+                jax.ShapeDtypeStruct((), rdt),
+                tuple(sds(o[0]) for o in ops),
+                tuple(sds(o[1]) for o in ops),
+                tuple(sds(o[2]) for o in ops),
+                tuple(jax.tree_util.tree_map(sds, o[3]) for o in ops),
+                tuple(jax.ShapeDtypeStruct((), np.int64) for _ in seg),
+                metas=B.factor_seg_metas(sched, seg, dtype),
+                pair=False)
         lowered.compile()
 
     # X carries promote(factor, rhs) and is real-encoded for complex
@@ -228,7 +280,8 @@ def warmup_staged(plan, dtype="float32", nrhs: int = 1,
 
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=workers) as ex:
-        list(ex.map(compile_factor, fsigs.items()))
+        list(ex.map(compile_factor_seg if merged_factor
+                    else compile_factor, fsigs.items()))
         if merged:
             list(ex.map(compile_seg, ssigs.items()))
         else:

@@ -39,6 +39,17 @@ jax.config.update("jax_compilation_cache_dir", host_cache_dir(
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+# AOT executable persistence (resilience/aot.py, ISSUE 12), the
+# trace-side twin of the compile cache above: whole-phase factor /
+# packed-solve builds DESERIALIZE their exported programs instead of
+# re-tracing — the suite builds hundreds of them.  Exports are
+# StableHLO, ISA-independent (the ISA-sensitive executables live in
+# the fingerprinted compile cache), so one shared dir is safe; stale
+# entries are refused by fingerprint, never served.  setdefault so a
+# test (or operator) env override wins.
+os.environ.setdefault("SLU_AOT_CACHE", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache", "aot"))
 
 
 # --- hang containment -----------------------------------------------

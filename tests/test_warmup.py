@@ -17,7 +17,7 @@ from superlu_dist_tpu.plan.plan import plan_factorization
 from superlu_dist_tpu.utils.warmup import staged_signatures, warmup_staged
 
 
-def _testmat(m=40):
+def _testmat(m=28):
     t = sp.diags([-1.0, 2.3, -1.1], [-1, 0, 1], shape=(m, m))
     return csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
 
@@ -43,7 +43,7 @@ def test_staged_run_after_warmup_is_correct(monkeypatch):
     functions, lowered with the same signatures)."""
     monkeypatch.setenv("SLU_STAGED", "1")
     from superlu_dist_tpu import gssvx
-    a = _testmat(30)
+    a = _testmat(24)
     rng = np.random.default_rng(0)
     xtrue = rng.standard_normal(a.n)
     plan = plan_factorization(a, Options(factor_dtype="float32"))
@@ -80,7 +80,7 @@ from superlu_dist_tpu.sparse import csr_from_scipy
 from superlu_dist_tpu.plan.plan import plan_factorization
 from superlu_dist_tpu.utils.warmup import staged_signatures, warmup_staged
 from superlu_dist_tpu.ops.batched import get_schedule
-t = sp.diags([-1.0, 2.3, -1.1], [-1, 0, 1], shape=(30, 30))
+t = sp.diags([-1.0, 2.3, -1.1], [-1, 0, 1], shape=(24, 24))
 a = csr_from_scipy(sp.kronsum(t, t, format="csr").tocsr())
 plan = plan_factorization(a, Options(factor_dtype="float32"))
 """

@@ -14,6 +14,11 @@ tolerance:
   * serve      — solves/s floor, p95/p99 ceilings, recompiles == 0
   * flight_ab  — flight-recorder overhead within the declared frac
   * solve      — per-nrhs per-rhs latency ceilings
+  * factor     — per-(arm, n) staged factor-wall ceilings + the
+                 bitwise merged==legacy pin (bench.py --factor-ab)
+  * cold_boot  — fresh-process drill: factorizations == 0,
+                 aot_misses == 0, aot_rejected == 0, gate.passed
+                 (serve_bench --cold-boot, the compile-skip contract)
   * prec_ab    — per-arm berr must stay in its accuracy CLASS
                  (ratio-bounded: a berr that grows 100x left its
                  class; absolute drift within a class is noise)
@@ -151,7 +156,16 @@ def gather(root: str) -> dict:
             add(rec.get("platform"), "serve", rec)
         elif mode == "flight_ab":
             add(rec.get("platform"), "flight_ab", rec)
+        elif mode == "cold_boot":
+            add(rec.get("platform"), "cold_boot", rec)
     for rec in _read_jsonl(os.path.join(root, "SOLVE_LATENCY.jsonl")):
+        if rec.get("mode") == "factor_ab":
+            # staged factor A/B records (bench.py --factor-ab): gate
+            # per (arm, n) t_factor_s — a merged-arm regression fails
+            # independently of the legacy arm's ceiling
+            add(rec.get("platform"),
+                f"factor.{rec.get('arm')}.n{rec.get('n')}", rec)
+            continue
         if rec.get("per_rhs_ms") is not None:
             # trisolve A/B records (bench.py --solve-sweep) carry an
             # `arm` field and gate per (arm, nrhs) — a merged-arm
@@ -291,6 +305,39 @@ def check(history: dict, baselines: dict) -> list[dict]:
                            _num(latest, "per_rhs_ms"),
                            base.get("per_rhs_ms"),
                            tol["latency_rise_frac"])
+            elif chk.startswith("factor."):
+                ceil_check(p, chk, "t_factor_s",
+                           _num(latest, "t_factor_s"),
+                           base.get("t_factor_s"),
+                           tol["latency_rise_frac"])
+                v = latest.get("bitwise_equal")
+                if v is not None:
+                    findings.append(_finding(
+                        p, chk, "bitwise_equal", bool(v), True, True,
+                        "ok" if v else "fail",
+                        "" if v else "merged factor sweep diverged "
+                        "from the legacy sweep bitwise"))
+            elif chk == "cold_boot":
+                zero_check(p, chk, "factorizations",
+                           _num(latest, "factorizations"),
+                           "the warm-artifact fresh process "
+                           "re-factored instead of adopting the "
+                           "store entry")
+                zero_check(p, chk, "aot_misses",
+                           _num(latest, "aot_misses"),
+                           "a whole-phase program re-traced instead "
+                           "of deserializing from the AOT cache")
+                zero_check(p, chk, "aot_rejected",
+                           _num(latest, "aot_rejected"),
+                           "an AOT entry failed verification on the "
+                           "warm boot")
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the cold-boot drill gate itself "
+                    "failed"))
             elif chk == "prec_ab":
                 arms = latest.get("arms", {})
                 for arm, b_arm in sorted(base.get("berr", {}).items()):
@@ -391,6 +438,12 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                 dst[chk] = {"per_rhs_ms": _median(
                     [v for r in win
                      if (v := _num(r, "per_rhs_ms")) is not None])}
+            elif chk.startswith("factor."):
+                dst[chk] = {"t_factor_s": _median(
+                    [v for r in win
+                     if (v := _num(r, "t_factor_s")) is not None])}
+            elif chk == "cold_boot":
+                dst[chk] = {}          # structural zero-gates only
             elif chk == "prec_ab":
                 berr: dict = {}
                 for r in win:

@@ -509,11 +509,190 @@ def run_chaos(spec=None, argv=()):
     return rec
 
 
+def run_cold_boot_child(k: int, requests: int) -> dict:
+    """One fresh-interpreter serve pass against the drill's shared
+    store + AOT cache (SLU_FT_STORE / SLU_AOT_CACHE from the parent's
+    env): prefactor-or-adopt the key, serve `requests` solves, and
+    report the counters the gate reads.  Printed as a RESULT line —
+    the test_warmup subprocess protocol."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import jax
+
+    # persistent compile-cache hit/miss counters (the warmup drill's
+    # monitoring-event probe): informational — the GATE rides the
+    # deterministic AOT counters
+    cc_hits, cc_misses = [0], [0]
+
+    def _listen(event, *a, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            cc_hits[0] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            cc_misses[0] += 1
+    jax.monitoring.register_event_listener(_listen)
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.resilience import aot
+    from superlu_dist_tpu.serve import ServeConfig, SolveService
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    t_boot = time.perf_counter()
+    a = laplacian_3d(k)
+    opts = Options(factor_dtype="float64")
+    svc = SolveService(ServeConfig(max_queue_depth=256))
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    svc.prefactor(a, opts)          # factor-or-adopt + bucket warmup
+    t_warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    x = svc.solve(a, rng.standard_normal(a.n), opts)
+    t_first = time.perf_counter() - t0
+    finite = bool(np.all(np.isfinite(np.asarray(x))))
+    for _ in range(max(0, requests - 1)):
+        svc.solve(a, rng.standard_normal(a.n), opts)
+    st = svc.cache.stats()
+    rec = {
+        "factorizations": st["factorizations"],
+        "store_hits": st.get("store_hits", 0),
+        "aot": aot.stats(),
+        "t_warm_s": round(t_warm, 3),
+        "t_first_solve_s": round(t_first, 4),
+        "t_ready_s": round(time.perf_counter() - t_boot, 3),
+        "compile_cache_hits": cc_hits[0],
+        "compile_cache_misses": cc_misses[0],
+        "finite": finite,
+    }
+    svc.close()
+    print("RESULT " + json.dumps(rec))
+    return rec
+
+
+def run_cold_boot(argv=(), k=None, requests=None, out_path=None):
+    """Fresh-PROCESS cold-boot drill (ISSUE 12; the PR 5 restart
+    drill's compile-side peer).  Two child interpreters run the same
+    serve pass against ONE shared durable store + AOT cache:
+
+      * child 1 (genuinely cold) factors, exports the whole-phase
+        programs write-through, and populates the store + the
+        compilation cache;
+      * child 2 (fresh process, warm artifacts) must serve with
+        `factorizations == 0` (store adoption — the PR 5 contract)
+        AND `aot.misses == 0` with `aot.hits >= 1` (every AOT-wrapped
+        whole-phase program deserialized instead of re-traced — the
+        new contract), i.e. the 14–33 s jit warmup and the 2m4s
+        whole-phase compile (BENCH_r05) are both skipped.
+
+    Appends one `mode=cold_boot` line to SLU_SERVE_OUT (default
+    SERVE_LATENCY.jsonl); tools/regress.py gates the counters.  A
+    failed gate stamps measurement_invalid, persists nothing, and
+    exits 1 (the --solve-sweep convention)."""
+    import shutil
+    import subprocess
+    import tempfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    k = k if k is not None else int(os.environ.get("SLU_SERVE_K", "8"))
+    requests = (requests if requests is not None
+                else min(int(os.environ.get("SLU_SERVE_REQUESTS",
+                                            "32")), 64))
+    out_path = out_path or os.environ.get(
+        "SLU_SERVE_OUT", os.path.join(repo, "SERVE_LATENCY.jsonl"))
+    store_dir = tempfile.mkdtemp(prefix="slu_cold_store_")
+    aot_dir = tempfile.mkdtemp(prefix="slu_cold_aot_")
+
+    def child(tag):
+        env = dict(os.environ)
+        env["SLU_FT_STORE"] = store_dir
+        env["SLU_AOT_CACHE"] = aot_dir
+        # hermetic compile cache: the drill proves the <aot>/xla leg,
+        # not whatever cache the ambient environment points at
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        t0 = time.perf_counter()
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cold-boot-child", str(k), str(requests)],
+            env=env, capture_output=True, text=True, timeout=3600)
+        wall = time.perf_counter() - t0
+        if p.returncode != 0:
+            print(p.stderr[-4000:], file=sys.stderr)
+            raise SystemExit(f"cold-boot child ({tag}) failed rc="
+                             f"{p.returncode}")
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        d = json.loads(line[len("RESULT "):])
+        d["proc_wall_s"] = round(wall, 2)
+        return d
+
+    try:
+        print(f"# cold-boot drill: child 1 (cold) k={k} ...",
+              file=sys.stderr)
+        first = child("cold")
+        print(f"# cold-boot drill: child 2 (warm artifacts) ...",
+              file=sys.stderr)
+        second = child("warm")
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+    import jax  # platform stamp only; children did the real work
+    dev = jax.devices()[0]
+    gate = {
+        "warm_store": second["factorizations"] == 0
+        and second["store_hits"] >= 1,
+        "aot_no_retrace": (second["aot"]["misses"] == 0
+                           and second["aot"]["rejected"] == 0
+                           and second["aot"]["hits"] >= 1),
+        "cold_exported": first["aot"]["saves"] >= 1,
+        "finite": first["finite"] and second["finite"],
+    }
+    gate["passed"] = all(gate.values())
+    rec = {
+        "mode": "cold_boot",
+        "desc": f"fresh-process cold-boot drill 3D Laplacian "
+                f"n={k ** 3}",
+        "k": k, "n": k ** 3, "requests": requests,
+        "cold": first, "warm": second,
+        "factorizations": second["factorizations"],
+        "aot_hits": second["aot"]["hits"],
+        "aot_misses": second["aot"]["misses"],
+        "aot_rejected": second["aot"]["rejected"],
+        "warm_ready_s": second["t_ready_s"],
+        "cold_ready_s": first["t_ready_s"],
+        "ready_speedup": round(
+            first["t_ready_s"] / max(second["t_ready_s"], 1e-9), 2),
+        "gate": gate,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    if not gate["passed"]:
+        rec["measurement_invalid"] = True
+        print(json.dumps(rec))
+        print(f"# COLD-BOOT GATE FAILED: {gate}", file=sys.stderr)
+        raise SystemExit(1)
+    line = json.dumps(rec)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    return rec
+
+
 def _regress_gate(repo):
     """Post-run perf-regression sentinel: the record just appended is
     now the latest — gate it against the committed baselines."""
     if os.environ.get("SLU_REGRESS", "1") == "0":
         return
+    # script-style invocation (tpu_fire.sh: `python tools/serve_bench.py`)
+    # puts tools/ on sys.path, not the repo root; the cold-boot parent
+    # never calls _setup() (it only orchestrates child processes), so
+    # ensure the root is importable here
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
     from tools import regress
     findings, passed = regress.check_repo(repo)
     print(regress.format_findings(findings), file=sys.stderr)
@@ -526,6 +705,16 @@ def _regress_gate(repo):
 
 def main():
     argv = sys.argv[1:]
+    if "--cold-boot-child" in argv:
+        i = argv.index("--cold-boot-child")
+        run_cold_boot_child(int(argv[i + 1]), int(argv[i + 2]))
+        return
+    if "--cold-boot" in argv:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        run_cold_boot(argv)
+        _regress_gate(repo)
+        return
     if "--fleet" in argv:
         # the multi-process fleet drill (tools/fleet_drill.py):
         # replica pool + shared store + kill -9, gated via FLEET.jsonl

@@ -68,6 +68,23 @@ def has_host_callback(hlo_text: str) -> bool:
     return any(tok in hlo_text for tok in _CALLBACK_TOKENS)
 
 
+def promised_scatter_present(hlo_text: str) -> bool:
+    """True when some scatter op carries BOTH parallel-lowering
+    promises (indices_are_sorted + unique_indices) — the PR 1
+    assembly-scatter discipline.  Factor programs cannot be
+    scatter-free (the A-assembly is a scatter by design), so their
+    contract pins the promises surviving the lowering instead: if a
+    refactor drops them, the only promised scatters in the module
+    disappear and this predicate goes false.  Both promises must sit
+    on the SAME op (MLIR prints an op's attribute dict inline on one
+    line): module-wide substring presence would stay green when the
+    assembly scatter loses one promise while another scatter still
+    carries it."""
+    return any("indices_are_sorted = true" in ln
+               and "unique_indices = true" in ln
+               for ln in hlo_text.splitlines())
+
+
 def donation_present(hlo_text: str) -> bool:
     """True when the lowered module carries donated-operand aliasing
     (jax 0.4.x lowers donate_argnums as tf.aliasing_output attrs;
@@ -85,6 +102,9 @@ CHECKS = {
                                    "host callback present"),
     "donation_honored": lambda t: (donation_present(t),
                                    "no donated-operand aliasing"),
+    "assembly_scatter_promised": lambda t: (
+        promised_scatter_present(t),
+        "no scatter carries the sorted+unique promises"),
 }
 
 # package modules that declare HLO_CONTRACTS (kept explicit: walking
@@ -93,6 +113,7 @@ CHECKS = {
 CONTRACT_MODULES = (
     "superlu_dist_tpu.ops.trisolve",
     "superlu_dist_tpu.ops.spmv",
+    "superlu_dist_tpu.ops.batched",
     "superlu_dist_tpu.precision.doubleword",
 )
 

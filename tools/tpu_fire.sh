@@ -163,6 +163,30 @@ if [ "${SLU_FIRE_DRYRUN:-0}" != "1" ]; then
   SLU_BENCH_ASSUME_LIVE=1 SLU_TRISOLVE_PALLAS=1 timeout 1200 \
     python "$repo/bench.py" --solve-sweep 2>> "$log"
   stamp "solve_sweep A/B (pallas lsum) rc=$?"
+  # 4c. Factor A/B at the round's HEAD (ISSUE 12): per-group staged
+  #     dispatch vs level-merged segment dispatch, same plan, same
+  #     moment, bitwise-gated — bench.py --factor-ab appends
+  #     mode="factor_ab" arm-tagged records to SOLVE_LATENCY.jsonl
+  #     and FAILS (persisting nothing) on a bitwise divergence or a
+  #     missed SLU_FACTOR_MIN_SPEEDUP floor; on hardware the floor is
+  #     raised to the dispatch-latency contract (the CPU default 1.0
+  #     is the timeshared-noise never-lose rehearsal bar).  A second
+  #     pass prices the promoted Pallas panel-LU inner kernel — it
+  #     lands as arm="merged+pallas" under its own regress ceiling.
+  SLU_BENCH_ASSUME_LIVE=1 SLU_FACTOR_MIN_SPEEDUP=${SLU_FACTOR_MIN_SPEEDUP:-1.2} \
+    timeout 3600 python "$repo/bench.py" --factor-ab 2>> "$log"
+  stamp "factor A/B rc=$?"
+  SLU_BENCH_ASSUME_LIVE=1 SLU_FACTOR_MIN_SPEEDUP=${SLU_FACTOR_MIN_SPEEDUP:-1.2} \
+    SLU_TPU_PALLAS=1 timeout 3600 python "$repo/bench.py" --factor-ab 2>> "$log"
+  stamp "factor A/B (pallas panel-LU) rc=$?"
+  # 4d. Fresh-process cold-boot drill (ISSUE 12): two child
+  #     interpreters on one shared store + AOT cache; the second must
+  #     serve with factorizations==0 and zero AOT misses.  Appends a
+  #     mode="cold_boot" record to SERVE_LATENCY.jsonl; SLU_REGRESS=0
+  #     because the full sentinel runs at the end of the plan.
+  SLU_REGRESS=0 timeout 3600 \
+    python "$repo/tools/serve_bench.py" --cold-boot >> "$log" 2>&1
+  stamp "cold-boot drill rc=$?"
   # 5. Sequential-chain arms (the latency-bound hypothesis — the
   #    round's ONE JOB, so they run BEFORE the multi-hour sweep).
   #    SLU_DIAG_UNROLL fuses more rank-1 pivot steps per XLA body;
