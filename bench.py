@@ -982,10 +982,15 @@ def main():
                          "tools", "serve_bench.py"),
             run_name="__main__")
         return
-    if "--serve" in sys.argv[1:]:
-        # serve-mode load benchmark (tools/serve_bench.py): factor
-        # once, drive concurrent solves through the micro-batching
-        # service, append the record to SERVE_LATENCY.jsonl
+    if ("--serve" in sys.argv[1:]
+            or "--stream" in sys.argv[1:]):
+        # serve_bench dispatch: --serve is the serve-mode load
+        # benchmark (factor once, concurrent solves through the
+        # micro-batching service); --stream the streaming-
+        # refactorization drift drill (ISSUE 13: transient-sim load
+        # with per-step value drift — overlap A/B plus the mid-swap
+        # kill -9 / warm-restart drill).  Both append to
+        # SERVE_LATENCY.jsonl, gated by tools/regress.py
         import runpy
         runpy.run_path(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),

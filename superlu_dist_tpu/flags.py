@@ -84,7 +84,7 @@ FLAGS: dict[str, str] = {
     "SLU_PREC_AB_OUT": "bench.py --prec output path (default PREC_AB.jsonl)",
     # --- resilience (resilience/, serve/factor_cache.py) ---
     "SLU_FT_STORE": "durable factor-store directory: FactorCache write-through/read-through persistence tier (atomic rename + sha256 framing + per-array ABFT checksum; corrupt entries quarantined to *.quarantined, never served; a restarted replica boots warm)",
-    "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds); deterministic per-site seeded streams; every site is one pointer check when unset",
+    "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds), store_latency, lease_steal, replica_kill, refactor_raise, refactor_slow, swap_kill (the stream pipeline's background-failure + mid-swap-crash sites); deterministic per-site seeded streams; every site is one pointer check when unset",
     "SLU_CHAOS_SEED": "chaos RNG seed (default 0): same spec+seed replays the identical failure sequence",
     "SLU_CHAOS_OUT": "serve_bench --chaos record path (default CHAOS.jsonl)",
     # --- fleet coordination (fleet/, serve/, tools/fleet_drill.py) ---
@@ -98,6 +98,17 @@ FLAGS: dict[str, str] = {
     "SLU_FLEET_K": "fleet drill grid size k (3D Laplacian, n=k^3; default 4)",
     "SLU_FLEET_OUT": "fleet drill record path (default FLEET.jsonl)",
     "SLU_FLEET_KILL_AFTER": "fraction of the drill's load phase served before the victim replica is kill -9'd (default 0.33)",
+    "SLU_SERVE_BLAS_THREADS": "host BLAS pool size pinned by the first SolveService, process-wide (default 1; 0 = leave the pool alone; needs threadpoolctl, silently no-op without it) — a multi-threaded OpenBLAS pool's spin-wait barriers let one caller monopolize every core, so a background refactorization's host BLAS stalls concurrent solves (stream overlap A/B measured 1.45x p99 before the pin, 1.05x after); zero per-request overhead (one-time pool resize)",
+    # --- streaming refactorization (stream/, tools/serve_bench.py --stream) ---
+    "SLU_STREAM_TRIP": "stream cadence escalation threshold as a fraction of the hard berr-guard limit (default 0.25): a stale solve's refined berr past trip_frac x 64·eps(refine_dtype) fires the stream_drift health escalation and requests a background refactorization; the hard limit itself always withholds the result (typed StaleFactorError, never served past the guard)",
+    "SLU_STREAM_INTERVAL_SCALE": "minimum seconds between background refactor starts as a multiple of the measured factorization cost (default 1.0) — bounds the pipeline's background duty cycle; the cost estimate is the handle's own refactor-wall EWMA, falling back to the arm-aware factor_cost_hint_s trajectory (the same figure that sizes fleet lease TTLs)",
+    "SLU_STREAM_MAX_LAG": "steps the live values may trail the resident generation before a refactor is forced regardless of berr (default 0 = disabled; drift in the measured berr is the primary cadence signal)",
+    "SLU_STREAM_PROBE": "1/0 probe solve before a generation publishes (default 1): one refined solve on the fresh factors — builds the PackSet, warms the nrhs=1 program, and refuses a factorization whose solve path is broken; costs one solve per refactorization, zero on the serve path",
+    "SLU_STREAM_STEPS": "serve_bench --stream value-drift step count per load phase (default 24)",
+    "SLU_STREAM_STEP_HZ": "serve_bench --stream drift step rate in steps/s (default 4)",
+    "SLU_STREAM_DRIFT": "serve_bench --stream per-step relative value drift amplitude (default 5e-4: calibrated so a full 24-step walk refines ~2 decades inside the berr guard off the pinned generation-1 factors; 2e-3 breaches by step ~8)",
+    "SLU_STREAM_TRIALS": "serve_bench --stream interleaved overlap A/B pair count (default 3; the measurement is the p99 ratio over each arm's POOLED ok latencies across all trials — per-pair ratios ride the worst ~2 samples of each run and flip on scheduler noise; they stay in the record as pair_ratios)",
+    "SLU_STREAM_OVERLAP_TOL": "serve_bench --stream gate ceiling on steady-state p99 of the background-refactor arm over the pinned (no-refactor) arm (default 1.10 — the ISSUE-13 overlap acceptance); a failed gate stamps measurement_invalid and persists nothing",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---

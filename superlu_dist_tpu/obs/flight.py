@@ -92,6 +92,8 @@ FAILED_STAGE = {
     "degraded": "factor",       # the REFACTORIZATION failed; the
                                 # degraded solve itself succeeded
     "flusher_dead": "batch",
+    "stale_rejected": "solve",  # the stream berr guard withheld the
+                                # result (stale-factor drift)
     "deadline": "queue",
     "serve_error": "serve",
     "error": "serve",

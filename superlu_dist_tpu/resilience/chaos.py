@@ -26,6 +26,20 @@ Spec grammar (`SLU_CHAOS` or `install(spec)`):
                                   first fires (DRILL-ONLY: the process
                                   dies the way `kill -9` kills it — no
                                   handlers, no cleanup)
+        refactor_raise=0.3        30% of BACKGROUND refactorizations
+                                  raise (the stream pipeline worker's
+                                  own failure site; the foreground
+                                  factor path keeps factor_raise)
+        refactor_slow=0.5:0.1     50% of background refactorizations
+                                  sleep 100 ms first (a long factor
+                                  the stale-serving path must ride)
+        swap_kill=1               synchronous self-SIGKILL inside the
+                                  resident-swap publish window —
+                                  after the durable store holds the
+                                  new generation, before the
+                                  in-memory assignment (DRILL-ONLY:
+                                  the mid-swap crash the warm-restart
+                                  gate proves safe)
 
 Determinism: each site owns a `random.Random` seeded from
 (`SLU_CHAOS_SEED`, site name), so the same spec+seed replays the same
@@ -47,7 +61,8 @@ import time
 from .. import flags
 
 SITES = ("factor_raise", "factor_nan", "store_flip", "flusher_raise",
-         "latency", "store_latency", "lease_steal", "replica_kill")
+         "latency", "store_latency", "lease_steal", "replica_kill",
+         "refactor_raise", "refactor_slow", "swap_kill")
 
 
 def _stable_seed(seed: int, *legs) -> int:
@@ -197,6 +212,23 @@ def maybe_replica_kill(site: str = "replica_kill") -> bool:
     threading.Thread(target=_die, name="chaos-replica-kill",
                      daemon=True).start()
     return True
+
+
+def maybe_sigkill(site: str = "swap_kill") -> None:
+    """DRILL-ONLY synchronous self-`kill -9` AT the call site: when
+    `site` fires the process dies on this very line — no delay, no
+    handlers, no cleanup.  The stream pipeline plants it between a
+    generation's durable publication and its in-memory swap
+    (stream/pipeline.py), so the drift drill crashes a replica at the
+    worst instant of the hand-off and proves the restart boots warm
+    from whichever generation the store last published.  One pointer
+    check when chaos is off; inert unless the spec names the site."""
+    p = _POLICY
+    if p is None or not p.should(site):
+        return
+    import os
+    import signal
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_poison_factors(site: str, lu) -> None:

@@ -27,10 +27,10 @@ Quickstart:
 from .batcher import BUCKET_LADDER, MicroBatcher, bucket_for
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, ServeError,
-                     ServeRejected, factor_cost_hint)
+                     ServeRejected, StaleFactorError, factor_cost_hint)
 from .factor_cache import (CacheKey, FactorCache, matrix_key,
                            pattern_fingerprint, values_fingerprint)
-from .loadgen import run_load
+from .loadgen import run_load, run_stream_load
 from .metrics import Counter, Histogram, Metrics
 from .service import ServeConfig, SolveService, solve_jit_cache_size
 
@@ -51,11 +51,13 @@ __all__ = [
     "ServeError",
     "ServeRejected",
     "SolveService",
+    "StaleFactorError",
     "bucket_for",
     "factor_cost_hint",
     "matrix_key",
     "pattern_fingerprint",
     "run_load",
+    "run_stream_load",
     "solve_jit_cache_size",
     "values_fingerprint",
 ]

@@ -60,6 +60,16 @@ class FlusherDead(ServeError):
     on the next request for the key."""
 
 
+class StaleFactorError(ServeError):
+    """A STREAMING solve's stale-factor refinement could not reach
+    the sold accuracy class: the live values have drifted past what
+    the resident generation's factors can cover, the berr guard
+    refused the result (never served past the guard), and an urgent
+    background refactorization was requested (stream/pipeline.py).
+    The caller should resubmit — the next generation covers the
+    drift — or treat it as the bounded-staleness contract firing."""
+
+
 class DegradedResult(np.ndarray):
     """Marker subclass stamped on solutions served in DEGRADED mode:
     a refactorization failed (or the key is circuit-broken) and the

@@ -292,6 +292,23 @@ class FactorCache:
                 return t_key, t_lu, d
         return None
 
+    def evict(self, key: CacheKey) -> Optional[LUFactorization]:
+        """Explicitly drop `key`'s resident factors (a probe-refused
+        stream generation, operator invalidation).  Fires on_evict
+        like a capacity eviction so dependent batchers retire; the
+        pattern-tier plan stays (the NEXT factorization of this
+        pattern reuses it legitimately).  Returns the evicted handle
+        or None."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return None
+            self.bytes_resident -= e.nbytes
+            self.metrics.inc("factor_cache.evictions")
+        if self.on_evict is not None:
+            self.on_evict(key, e.lu)
+        return e.lu
+
     def get(self, key: CacheKey) -> Optional[LUFactorization]:
         """Plain lookup (counts a hit/miss, refreshes LRU position)."""
         with self._lock:

@@ -25,7 +25,7 @@ import numpy as np
 
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, ServeError,
-                     ServeRejected)
+                     ServeRejected, StaleFactorError)
 from .service import SolveService
 
 
@@ -83,36 +83,15 @@ def run_load(service: SolveService, matrices, *,
             # (None with SLU_FLIGHT off) keys the exemplar report
             info: dict = {}
             t0 = time.monotonic()
-            try:
-                x = service.solve(matrices[mi], b, options=options,
-                                  deadline_s=deadline_s, info=info)
-                if not np.all(np.isfinite(x)):
-                    # a non-finite "success" is the one outcome the
-                    # chaos gate forbids outright — never fold it into
-                    # ok OR degraded
-                    status = "nonfinite"
-                elif isinstance(x, DegradedResult):
-                    status = "degraded"
-                else:
-                    status = "ok"
-            except ServeRejected:
-                status = "rejected"
-            except DeadlineExceeded:
-                status = "deadline"
-            except FactorMissError:
-                status = "miss_failfast"
-            except FactorPoisoned:
-                status = "poisoned"
-            except FlusherDead:
-                status = "flusher_dead"
-            except ServeError:
-                status = "serve_error"
-            except Exception:
-                # a worker must never die silently: an unexpected
-                # error (solver failure re-raised from a batch future,
-                # shape/dtype rejection) is a recorded outcome, not a
-                # truncated report
-                status = "error"
+            # ONE status taxonomy (_status_of_solve) for every load
+            # generator — a second inline except-chain here had
+            # already drifted from it (StaleFactorError folded into
+            # serve_error)
+            status, _x = _status_of_solve(
+                lambda: service.solve(matrices[mi], b,
+                                      options=options,
+                                      deadline_s=deadline_s,
+                                      info=info))
             with res_lock:
                 results.append((time.monotonic() - t0, status,
                                 info.get("request_id")))
@@ -160,6 +139,251 @@ def run_load(service: SolveService, matrices, *,
             return nearest_rank(ok_lat, p) * 1e3
         report.update(p50_ms=pct(50), p95_ms=pct(95), p99_ms=pct(99),
                       mean_ms=float(ok_lat.mean()) * 1e3)
+    return report
+
+
+def _status_of_solve(do_solve) -> tuple[str, object]:
+    """Run one blocking solve; map the outcome to the status
+    taxonomy.  Returns (status, x-or-None)."""
+    try:
+        x = do_solve()
+    except ServeRejected:
+        return "rejected", None
+    except DeadlineExceeded:
+        return "deadline", None
+    except FactorMissError:
+        return "miss_failfast", None
+    except FactorPoisoned:
+        return "poisoned", None
+    except FlusherDead:
+        return "flusher_dead", None
+    except StaleFactorError:
+        # the stream berr guard withheld a result that left the
+        # accuracy class — a TYPED refusal, never a silent bad answer
+        return "stale_rejected", None
+    except ServeError:
+        return "serve_error", None
+    except Exception:
+        return "error", None
+    if not np.all(np.isfinite(x)):
+        return "nonfinite", None
+    if isinstance(x, DegradedResult):
+        return "degraded", x
+    return "ok", x
+
+
+def run_stream_load(streams, *, steps: int = 16,
+                    step_hz: float = 4.0,
+                    requests: int = 128, concurrency: int = 8,
+                    hot_fraction: float = 1.0,
+                    deadline_s: float | None = None,
+                    seed: int = 0,
+                    rate_hz: float | None = None,
+                    indices=None,
+                    journal_path: str | None = None,
+                    join_timeout_s: float | None = None) -> dict:
+    """Transient-simulation load: correlated keys with per-step value
+    drift (the ISSUE-13 scenario).  `streams` is a list of
+    `(StreamHandle, step_fn)` pairs — `step_fn(t) -> CSRMatrix`
+    produces step t's drifted values for that stream (t=0 is the
+    primed state; the stepper starts at t=1).  Index 0 is the hot
+    stream (`hot_fraction` skew, like run_load).
+
+    A stepper thread advances every stream at `step_hz`; meanwhile
+    `concurrency` closed-loop workers issue blocking solves against
+    the streams' LIVE values.  Request identity is DETERMINISTIC:
+    worker threads drain a shared index list (`indices`, default
+    range(requests)) and derive each request's stream pick and RHS
+    from (seed, index) alone — so a killed process's surviving
+    journal (`journal_path`, one flushed JSON line per completed
+    request) tells a successor EXACTLY which indices to replay.
+    That replay contract is what lets the drift drill account every
+    request across a mid-run kill -9 (tools/serve_bench.py
+    --stream).
+
+    `rate_hz` paces aggregate issuance (open-ish loop): request
+    number p is released at `t_start + p / rate_hz`, so the load
+    SPANS the drift window instead of draining before the first step
+    lands — without it a fast solve path finishes the whole request
+    list while every value set is still fresh and the drill measures
+    nothing.  Pacing is by drain position, not index, so a restart
+    replaying a sparse index list does not idle through the victim's
+    completed slots.
+
+    The report is run_load-shaped (by_status / percentiles /
+    unresolved) plus the stream-side story: swaps, fresh/stale solve
+    counts, guard breaches, and each stream's status() snapshot."""
+    import collections
+    import itertools
+    import json
+
+    streams = list(streams)
+    idx_queue = collections.deque(int(i) for i in
+                                  (indices if indices is not None
+                                   else range(requests)))
+    total = len(idx_queue)
+    n_workers = max(1, min(concurrency, total))
+    results: list[tuple[int, float, str, object]] = []
+    res_lock = threading.Lock()
+    stop_stepping = threading.Event()
+    journal = None
+    if journal_path:
+        import os
+        journal = open(journal_path, "a")
+        # a SIGKILLed predecessor (the kill drill's victim) can leave
+        # a TORN final line with no trailing newline; heal it so this
+        # process's first record doesn't concatenate onto the
+        # fragment (readers skip the fragment as unparseable and the
+        # index replays — accounting stays exact)
+        if os.path.getsize(journal_path) > 0:
+            with open(journal_path, "rb") as jf:
+                jf.seek(-1, os.SEEK_END)
+                if jf.read(1) != b"\n":
+                    journal.write("\n")
+                    journal.flush()
+
+    dims = [h.swap.current.a.n for h, _ in streams]
+    svc = streams[0][0].service
+    m = svc.metrics
+    # the stream.* counters are service-lifetime totals shared by
+    # every run on this service; the report's figures are THIS run's
+    # deltas so interleaved A/B arms don't inherit each other's
+    # (and the warmup pair's) solves
+    _CTRS = ("stream.refactors", "stream.refactor_failures",
+             "stream.fresh_solves", "stream.stale_solves",
+             "stream.guard_breaches", "stream.worker_died",
+             "stream.worker_restarts")
+    ctr0 = {c: m.counter(c) for c in _CTRS}
+
+    def stepper() -> None:
+        for t in range(1, steps + 1):
+            if stop_stepping.wait(1.0 / step_hz if step_hz > 0
+                                  else 0.0):
+                return
+            for h, step_fn in streams:
+                try:
+                    h.update(step_fn(t))
+                except ServeError:
+                    return          # stream closed under us: done
+        stop_stepping.set()
+
+    released = itertools.count()
+
+    def worker(wid: int) -> None:
+        while True:
+            try:
+                idx = idx_queue.popleft()
+            except IndexError:
+                return
+            if rate_hz:
+                due = t_start + next(released) / rate_hz
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            rng = np.random.default_rng(seed * 7919 + idx)
+            if len(streams) == 1 or rng.random() < hot_fraction:
+                si = 0
+            else:
+                si = 1 + int(rng.integers(len(streams) - 1))
+            b = rng.standard_normal(dims[si])
+            h = streams[si][0]
+            info: dict = {}
+            t0 = time.monotonic()
+            status, _x = _status_of_solve(
+                lambda: h.solve(b, deadline_s=deadline_s, info=info))
+            lat = time.monotonic() - t0
+            with res_lock:
+                results.append((idx, lat, status,
+                                info.get("request_id")))
+                if journal is not None:
+                    journal.write(json.dumps(
+                        {"i": idx, "status": status,
+                         "ms": round(lat * 1e3, 3)}) + "\n")
+                    journal.flush()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_workers)]
+    step_thread = threading.Thread(target=stepper, daemon=True)
+    t_start = time.monotonic()
+    step_thread.start()
+    for t in threads:
+        t.start()
+    if join_timeout_s is None:
+        for t in threads:
+            t.join()
+    else:
+        join_deadline = t_start + join_timeout_s
+        for t in threads:
+            t.join(max(0.0, join_deadline - time.monotonic()))
+    stop_stepping.set()
+    step_thread.join(timeout=10.0)
+    wall_s = time.monotonic() - t_start
+    # ONE locked snapshot: on the join-timeout path stragglers may
+    # still be appending, and computing unresolved / by_status /
+    # completed_indices from a mutating list would make the report
+    # internally inconsistent (unresolved=1 yet every index listed)
+    with res_lock:
+        results = list(results)
+    if journal is not None:
+        # close only if every worker really exited: a join that
+        # TIMED OUT leaves workers that may still complete solves,
+        # and their journal line (the kill-drill accounting record)
+        # must not die on a closed file.  res_lock serializes the
+        # check against an in-flight write; a leaked fd on the
+        # timeout path closes at process exit.
+        with res_lock:
+            if not any(t.is_alive() for t in threads):
+                journal.close()
+    svc.drain_observability()
+
+    by_status: dict[str, int] = {}
+    for _i, _lat, s, _rid in results:
+        by_status[s] = by_status.get(s, 0) + 1
+    from .metrics import nearest_rank
+    ok_lat = np.array(sorted(lat for _i, lat, s, _r in results
+                             if s == "ok"))
+    report = {
+        "requests": total,
+        "concurrency": n_workers,
+        "steps": steps,
+        "step_hz": step_hz,
+        "hot_fraction": hot_fraction,
+        "wall_s": wall_s,
+        "by_status": by_status,
+        "unresolved": total - len(results),
+        "completed_indices": sorted(i for i, *_ in results),
+        "solves_per_s": (len(ok_lat) / wall_s) if wall_s > 0 else 0.0,
+        "stream": {
+            "swaps": sum(h.swap.swaps - 1 for h, _ in streams),
+            "refactors": m.counter("stream.refactors")
+            - ctr0["stream.refactors"],
+            "refactor_failures":
+                m.counter("stream.refactor_failures")
+                - ctr0["stream.refactor_failures"],
+            "fresh_solves": m.counter("stream.fresh_solves")
+            - ctr0["stream.fresh_solves"],
+            "stale_solves": m.counter("stream.stale_solves")
+            - ctr0["stream.stale_solves"],
+            "guard_breaches": m.counter("stream.guard_breaches")
+            - ctr0["stream.guard_breaches"],
+            "worker_deaths": m.counter("stream.worker_died")
+            - ctr0["stream.worker_died"],
+            "worker_restarts": m.counter("stream.worker_restarts")
+            - ctr0["stream.worker_restarts"],
+            "handles": [h.status() for h, _ in streams],
+        },
+        "metrics": m.snapshot(),
+    }
+    if len(ok_lat):
+        def pct(p):
+            return nearest_rank(ok_lat, p) * 1e3
+        report.update(p50_ms=pct(50), p95_ms=pct(95), p99_ms=pct(99),
+                      mean_ms=float(ok_lat.mean()) * 1e3,
+                      # raw ok latencies (sorted, ms): the drill
+                      # pools these across trials so its overlap
+                      # gate reads a real percentile of the steady
+                      # state, not each run's worst-sample max
+                      ok_ms=[round(x * 1e3, 3) for x in ok_lat])
     return report
 
 

@@ -58,7 +58,7 @@ from ..sparse import CSRMatrix
 from .batcher import BUCKET_LADDER, MicroBatcher
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, ServeError,
-                     ServeRejected, factor_cost_hint)
+                     ServeRejected, StaleFactorError, factor_cost_hint)
 from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
@@ -109,6 +109,18 @@ def _merged_solve_fn(options: Options, metrics: Metrics | None = None,
     # histogram operators alert on
     fn.warmup_fn = lambda lu, B: raw(lu, B)[0]
     return fn
+
+
+def refine_wrapper(lu: "LUFactorization", a: CSRMatrix
+                   ) -> "LUFactorization":
+    """Stale factors as the preconditioner for a FRESH matrix: the
+    live values attached, with a private refine cache + lock so the
+    wrapper's refinement state never mixes with the resident
+    handle's.  Shared by the degraded fallback and the stream's
+    steady-state stale serving — the reset-per-wrapper invariants
+    live HERE, once."""
+    return dataclasses.replace(lu, a=a, refine_cache={},
+                               cache_lock=threading.Lock())
 
 
 def _mark_degraded(fut: Future) -> Future:
@@ -186,11 +198,42 @@ class ServeConfig:
         default_factory=lambda: bool(flags.env_int("SLU_FLEET", 0)))
 
 
+_BLAS_LIMITED = False
+_blas_limit_lock = threading.Lock()
+
+
+def _ensure_blas_limit() -> None:
+    """Pin the host BLAS pool for the serving process (once,
+    process-wide, first SolveService applies it).  A multi-threaded
+    OpenBLAS pool is the wrong shape for concurrent small solves: its
+    spin-wait barriers let ONE caller monopolize every core, so a
+    background factorization's host BLAS calls stall the whole solve
+    path — measured as the stream drill's overlap A/B failing at
+    1.45x p99 until this pin (1.05x after; the pinned arm's own p99
+    variance collapses too).  `SLU_SERVE_BLAS_THREADS` sizes it (1
+    default, 0 = leave the pool alone); degrades to a no-op without
+    threadpoolctl."""
+    global _BLAS_LIMITED
+    with _blas_limit_lock:
+        if _BLAS_LIMITED:
+            return
+        _BLAS_LIMITED = True
+    n = flags.env_int("SLU_SERVE_BLAS_THREADS", 1)
+    if n <= 0:
+        return
+    try:
+        import threadpoolctl
+        threadpoolctl.threadpool_limits(limits=n, user_api="blas")
+    except Exception:       # noqa: BLE001 — optional dependency
+        pass
+
+
 class SolveService:
     def __init__(self, config: ServeConfig | None = None,
                  metrics: Metrics | None = None,
                  cache: FactorCache | None = None) -> None:
         self.config = config or ServeConfig()
+        _ensure_blas_limit()
         if self.config.miss_policy not in ("factor", "failfast"):
             raise ValueError(
                 f"unknown miss_policy {self.config.miss_policy!r}")
@@ -250,6 +293,9 @@ class SolveService:
         # values — subsequent failures surface as errors, not as
         # berr-failing degraded answers
         self._degraded_blocked: set[CacheKey] = set()
+        # open matrix streams (stream/pipeline.py StreamHandle),
+        # closed with the service
+        self._streams: list = []
         self._inflight = 0
         self._closed = False
         # request-scoped observability scratch (the SLO key computed
@@ -284,11 +330,54 @@ class SolveService:
         self._batcher_for(key, lu, options).warmup()
         return key
 
+    def stream(self, a: CSRMatrix, options: Options | None = None,
+               config=None):
+        """Open a matrix STREAM on `a`'s pattern (stream/pipeline.py):
+        fixed structure, drifting values.  The returned StreamHandle
+        primes synchronously (store read-through makes a restarted
+        replica's prime warm), then serves every solve off the
+        resident generation — stale generations with fresh-matrix
+        refinement behind the berr guard — while a contained
+        background worker refactors on the drift cadence and
+        publishes via the atomic resident swap.  `config` is a
+        stream.StreamConfig."""
+        with self._lock:
+            if self._closed:
+                raise ServeError("service is closed")
+        from ..stream.pipeline import StreamHandle
+        h = StreamHandle(self, a, options, config)
+        with self._lock:
+            # close() may have drained _streams while the prime
+            # factorization ran; an append now would leave the handle
+            # (and its background worker) untracked forever
+            closed = self._closed
+            if not closed:
+                self._streams.append(h)
+        if closed:
+            h.close()
+            raise ServeError("service is closed")
+        return h
+
+    def _discard_stream(self, h) -> None:
+        """StreamHandle.close() deregisters itself here — a closed
+        stream left in _streams would pin its generations' factors
+        until service close (unbounded under pattern churn, e.g. the
+        scipy-compat pool's LRU retirement)."""
+        with self._lock:
+            try:
+                self._streams.remove(h)
+            except ValueError:
+                pass
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            streams = list(self._streams)
+            self._streams.clear()
+        for s in streams:
+            s.close()
         for b in batchers:
             b.close()
         self._drain_observability()
@@ -318,14 +407,18 @@ class SolveService:
     def submit(self, a: CSRMatrix | CacheKey, b: np.ndarray,
                options: Options | None = None,
                deadline_s: float | None = None,
-               _t0: float | None = None) -> Future:
+               _t0: float | None = None,
+               _router=None) -> Future:
         """Admit one solve request; resolves to x.  `a` may be the
         matrix itself or a CacheKey from prefactor() (keyed submits
         skip fingerprint hashing on the hot path).  `_t0` is the
         deadline base (solve() passes its own entry time so the
         blocking wait and the batcher enforce the SAME absolute
         deadline — a result landing in the skew window must not read
-        'ok' on a future whose caller already timed out).
+        'ok' on a future whose caller already timed out).  `_router`
+        (package-internal: stream/pipeline.py) replaces the cache
+        routing step with the caller's own — admission control,
+        flight lifecycle and SLO accounting stay the service's.
 
         With the flight recorder on (obs/flight.py, SLU_FLIGHT) the
         request gets a monotonic request ID — exposed as
@@ -358,7 +451,8 @@ class SolveService:
                       deadline_s=deadline_s)
         flight.set_current(rec)
         try:
-            future = self._route(a, b, options, deadline_s, t0=t0)
+            route = _router if _router is not None else self._route
+            future = route(a, b, options, deadline_s, t0=t0)
         except BaseException as e:
             with self._lock:
                 self._inflight -= 1
@@ -387,7 +481,8 @@ class SolveService:
     def solve(self, a: CSRMatrix | CacheKey, b: np.ndarray,
               options: Options | None = None,
               deadline_s: float | None = None,
-              info: dict | None = None) -> np.ndarray:
+              info: dict | None = None,
+              _router=None) -> np.ndarray:
         """Blocking submit; respects the deadline while waiting.
         Pass `info={}` to receive out-of-band request metadata —
         currently `info['request_id']`, the flight-recorder rid (None
@@ -397,7 +492,8 @@ class SolveService:
                       else self.config.default_deadline_s)
         t0 = time.monotonic()
         try:
-            future = self.submit(a, b, options, deadline_s, _t0=t0)
+            future = self.submit(a, b, options, deadline_s, _t0=t0,
+                                 _router=_router)
         except BaseException as e:
             if info is not None:
                 info["request_id"] = getattr(e, "request_id", None)
@@ -436,6 +532,7 @@ class SolveService:
                           (FactorPoisoned, "poisoned"),
                           (FlusherDead, "flusher_dead"),
                           (FactorMissError, "miss_failfast"),
+                          (StaleFactorError, "stale_rejected"),
                           (ServeError, "serve_error")):
             if isinstance(e, cls):
                 return name
@@ -755,9 +852,7 @@ class SolveService:
             return None
         s_key, s_lu = stale
         d_opts = self._degraded_options(a, s_lu, options)
-        handle = dataclasses.replace(
-            s_lu, a=a, refine_cache={},
-            cache_lock=threading.Lock())
+        handle = refine_wrapper(s_lu, a)
         try:
             mb = self._batcher_for(
                 s_key, handle, d_opts,

@@ -1,0 +1,252 @@
+"""`scipy.sparse.linalg` drop-in fronting the stream pipeline.
+
+Transient-stepping codes are written against scipy's factorization
+API — `lu = splu(A); x = lu.solve(b)` inside the time loop, a fresh
+`splu` per step because the values drifted.  Under scipy every one of
+those calls pays a full factorization.  This module keeps the calling
+convention and swaps the economics: `splu(A)` resolves to a
+`StreamHandle` keyed by A's sparsity pattern (+ factor options), so
+
+  * the FIRST call on a pattern factors synchronously (and, with a
+    durable store attached, a restarted process adopts it warm);
+  * every LATER call with drifted values returns IMMEDIATELY — its
+    `solve` rides the resident stale generation with refinement
+    against the new values behind the berr guard, while the
+    background pipeline refactors on the cadence's schedule
+    (stream/pipeline.py).  A 477 s-class factorization amortizes
+    into a background task the time loop never waits on.
+
+Each `StreamLU` captures the matrix it was built from: `lu.solve(b)`
+always refines against THAT system, even after later `splu` calls
+stepped the stream on — holding an old handle never silently solves
+a newer system (pinned in tests/test_stream.py).
+
+Coverage is the `splu`/`spsolve` surface transient codes actually
+use (solve with trans='N'|'T'|'H', 1-D and 2-D right-hand sides,
+`shape`/`nnz`/`perm_r`/`perm_c`); options beyond that (permc_spec,
+drop tolerances) are scipy-ILU territory and raise.  Accepts scipy
+sparse matrices and the package's own CSRMatrix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..options import Options, Trans
+from ..serve.errors import ServeError
+from ..serve.service import ServeConfig, SolveService
+from ..sparse import CSRMatrix, csr_from_scipy
+from .pipeline import StreamConfig, StreamHandle
+
+# pattern-keyed stream pool: one StreamHandle per (pattern, factor
+# options); bounded LRU — an unbounded pattern sweep must not grow
+# background workers for the process lifetime
+_MAX_STREAMS = 16
+
+_lock = threading.Lock()
+_service: SolveService | None = None
+_owned_service = False
+_stream_config: StreamConfig | None = None
+_pool: dict = {}          # pattern_key -> StreamHandle (insertion = LRU)
+
+
+def configure(service: SolveService | None = None,
+              stream_config: StreamConfig | None = None) -> None:
+    """Install the service/stream policy the drop-in fronts (closing
+    any previous pool).  Without a call, a default SolveService is
+    built lazily on first use (store/fleet from the usual env
+    flags)."""
+    global _service, _owned_service, _stream_config
+    # swap-then-close, atomically under the lock: closing first would
+    # open a window where a concurrent splu() lazily builds an owned
+    # default service that the assignment below then overwrites and
+    # orphans (its stream workers with it)
+    with _lock:
+        handles = list(_pool.values())
+        _pool.clear()
+        old_svc, old_owned = _service, _owned_service
+        _service = service
+        _owned_service = False
+        _stream_config = stream_config
+    for h in handles:
+        h.close()
+    if old_svc is not None and old_owned:
+        old_svc.close()
+
+
+def close() -> None:
+    """Close every pooled stream (and the module-owned default
+    service, if one was built)."""
+    global _service, _owned_service
+    with _lock:
+        handles = list(_pool.values())
+        _pool.clear()
+        svc, owned = _service, _owned_service
+        _service = None
+        _owned_service = False
+    for h in handles:
+        h.close()
+    if svc is not None and owned:
+        svc.close()
+
+
+def _get_service() -> SolveService:
+    global _service, _owned_service
+    with _lock:
+        if _service is None:
+            _service = SolveService(ServeConfig())
+            _owned_service = True
+        return _service
+
+
+def _as_csr(A) -> CSRMatrix:
+    if isinstance(A, CSRMatrix):
+        return A
+    if hasattr(A, "tocsr"):               # any scipy.sparse matrix
+        return csr_from_scipy(A)
+    raise TypeError(
+        f"splu expects a scipy.sparse matrix or CSRMatrix, got "
+        f"{type(A).__name__}")
+
+
+def _handle_for(a: CSRMatrix, options: Options,
+                key=None) -> StreamHandle:
+    if key is None:
+        from ..serve.factor_cache import matrix_key
+        key = matrix_key(a, options)
+    pk = key.pattern_key
+    svc = _get_service()
+    retired = []
+    with _lock:
+        h = _pool.get(pk)
+        if h is not None:
+            # LRU touch
+            _pool.pop(pk)
+            _pool[pk] = h
+            return h
+    # build outside the lock (the prime factorization is expensive);
+    # a racing builder on the same pattern is resolved by the cache's
+    # own single-flight — last insert wins, the loser closes.  Built
+    # through the service front door, NOT StreamHandle directly: the
+    # closed-service guard applies and service.close() closes pooled
+    # streams like any other
+    h = svc.stream(a, options, _stream_config)
+    with _lock:
+        cur = _pool.get(pk)
+        if cur is not None:
+            retired.append(h)
+            h = cur
+        else:
+            _pool[pk] = h
+            while len(_pool) > _MAX_STREAMS:
+                old_key = next(iter(_pool))
+                retired.append(_pool.pop(old_key))
+    for old in retired:
+        old.close()
+    return h
+
+
+class StreamLU:
+    """The object `splu` returns — scipy's SuperLU surface over one
+    stream generation's worth of values."""
+
+    def __init__(self, handle: StreamHandle, key, a: CSRMatrix
+                 ) -> None:
+        self._handle = handle
+        self._key = key
+        self._a = a
+        self.shape = (a.m, a.n)
+        self.nnz = int(a.indptr[-1])
+
+    # scipy exposes the permutations the factorization chose
+    @property
+    def perm_r(self) -> np.ndarray:
+        return np.asarray(self._handle.swap.current.lu.plan.final_row)
+
+    @property
+    def perm_c(self) -> np.ndarray:
+        return np.asarray(self._handle.swap.current.lu.plan.final_col)
+
+    def solve(self, b, trans: str = "N") -> np.ndarray:
+        """Solve A x = b (trans='N'), Aᵀ x = b ('T') or Aᴴ x = b
+        ('H') against the values THIS object was built from.  2-D b
+        solves per column through the micro-batcher (the columns
+        coalesce into one padded dispatch)."""
+        tmap = {"N": Trans.NOTRANS, "T": Trans.TRANS, "H": Trans.CONJ}
+        if trans not in tmap:
+            raise ValueError(f"trans must be 'N', 'T' or 'H', got "
+                             f"{trans!r}")
+        opts = (None if trans == "N"
+                else self._handle.options.replace(trans=tmap[trans]))
+        b = np.asarray(b)
+        against = (self._key, self._a)
+        if b.ndim == 1:
+            return np.asarray(self._handle.solve(
+                b, against=against, options=opts))
+        if b.ndim != 2 or b.shape[0] != self._a.n:
+            raise ValueError(
+                f"b must be ({self._a.n},) or ({self._a.n}, k); got "
+                f"{b.shape}")
+        futs = [self._handle.submit(b[:, j], against=against,
+                                    options=opts)
+                for j in range(b.shape[1])]
+        return np.stack([np.asarray(f.result()) for f in futs],
+                        axis=1)
+
+    def stream_status(self) -> dict:
+        """Beyond-scipy introspection: the backing stream's state."""
+        return self._handle.status()
+
+
+def splu(A, options: Options | None = None, **kw) -> StreamLU:
+    """`scipy.sparse.linalg.splu`-shaped factorization front.  Extra
+    scipy keywords that would change the factorization semantics are
+    refused loudly (this is GESP static pivoting, not threshold
+    ILU)."""
+    if kw:
+        raise TypeError(
+            f"unsupported splu option(s) {sorted(kw)}: the TPU GESP "
+            "pipeline exposes its knobs via Options, not scipy's "
+            "permc_spec/diag_pivot_thresh surface")
+    from ..serve.factor_cache import matrix_key
+    a = _as_csr(A)
+    if a.m != a.n:
+        raise ValueError("can only factor square matrices")
+    options = options or Options()
+    # ONE fingerprint per call: matrix_key is an O(nnz) hash and this
+    # is the per-time-step hot path — the same key feeds the pool
+    # lookup, the drift comparison and (below) the stream step
+    key = matrix_key(a, options)
+    last: Exception | None = None
+    for _ in range(2):
+        h = _handle_for(a, options, key=key)
+        try:
+            # compare against the LIVE value set, not the resident
+            # generation: while a background refactor is still in
+            # flight the resident stays old, and re-stepping the
+            # stream on every call with the same matrix would count
+            # drift steps by call volume (inflating lag and, with
+            # SLU_STREAM_MAX_LAG, forcing spurious refactorizations)
+            live_key = h._ticket(None)[0]
+            if live_key.values != key.values:
+                # drifted values: step the stream (background
+                # refactor per the cadence) — returns without waiting
+                h.update(a, key=key)
+        except ServeError as e:
+            # a concurrent splu on a 17th pattern LRU-retired and
+            # closed the handle between pool fetch and use — rebuild
+            # once (a CLOSED SERVICE raises from _handle_for itself
+            # and propagates)
+            last = e
+            continue
+        return StreamLU(h, key, a)
+    raise last
+
+
+def spsolve(A, b, options: Options | None = None) -> np.ndarray:
+    """`scipy.sparse.linalg.spsolve`-shaped one-shot solve fronting
+    the same stream pool (repeated calls with drifting values never
+    re-pay the factorization inline)."""
+    return splu(A, options=options).solve(np.asarray(b))

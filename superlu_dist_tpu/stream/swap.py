@@ -1,0 +1,121 @@
+"""Atomic resident-factor swap: the store's rename discipline in RAM.
+
+The durable store (resilience/store.py) publishes a factorization by
+writing a complete, verified file and atomically renaming it into
+place — a reader sees the whole old entry or the whole new entry,
+never a torn one.  A streaming refactorization needs the identical
+discipline for the IN-MEMORY resident factors: solves ride generation
+k while generation k+1 is factored, validated and warmed in the
+background, and the hand-off must be one indivisible step.
+
+The in-memory analog of rename(2) here is a single reference
+assignment.  A `Generation` is a frozen dataclass built COMPLETELY
+before anyone can see it (factors + the matrix they were computed
+from + the cache key naming them + the monotonic generation number);
+`ResidentSwap.publish` stores it with one attribute write, and every
+reader takes one attribute read (`current`).  Both are single bytecode
+pointer operations on a fully-constructed immutable object — under
+CPython's memory model a reader observes strictly the old generation
+or strictly the new one.  There is nothing to lock on the solve path
+and nothing that can be observed half-written (pinned by the N-thread
+swap test in tests/test_stream.py).
+
+Publication ORDER is the crash-safety story (stream/pipeline.py): the
+durable store already holds the new generation (write-through happens
+at factorization time, before validation completes), so a process
+killed between store publication and this in-memory assignment — the
+`swap_kill` chaos site fires exactly there — restarts warm from
+whichever generation the store last published.  The in-memory swap is
+always a REPLAY of a durable publication, never ahead of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from ..models.gssvx import LUFactorization
+from ..serve.factor_cache import CacheKey
+from ..sparse import CSRMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    """One published resident factorization.  Frozen: a reader that
+    obtained a Generation can never observe its fields change — the
+    zero-torn-reads contract is immutability, not locking."""
+
+    gen: int                      # monotonic, 1-based
+    key: CacheKey                 # full cache key of these factors
+    lu: LUFactorization
+    a: CSRMatrix                  # the matrix the factors came from
+    step: Optional[int] = None    # the stream step that produced it
+    published_mono: float = 0.0   # time.monotonic() at publish
+
+    @property
+    def values(self) -> str:
+        """The values-sha1 leg — the drift identity of this
+        generation (two generations of one stream share pattern and
+        options and differ exactly here)."""
+        return self.key.values
+
+    def staleness_s(self, now: float | None = None) -> float:
+        return (time.monotonic() if now is None else now) \
+            - self.published_mono
+
+
+class ResidentSwap:
+    """Holder of the one resident generation.
+
+    Readers: `swap.current` — one attribute read, no lock.  Writers:
+    `publish(generation)` — one attribute write (publishers are
+    expected to be serialized by the pipeline's single worker; the
+    assignment itself is atomic regardless).  `history` keeps a small
+    bounded trail of (gen, values) pairs so tests and the drill can
+    check that every generation a reader ever observed was really
+    published (the torn-read pin needs the ground truth)."""
+
+    _HISTORY = 64
+
+    def __init__(self) -> None:
+        self._current: Optional[Generation] = None
+        self._lock = threading.Lock()     # guards history only
+        self._history: list[tuple[int, str]] = []
+        self.swaps = 0
+
+    @property
+    def current(self) -> Optional[Generation]:
+        return self._current
+
+    def publish(self, generation: Generation) -> Generation:
+        """Install `generation` as THE resident one.  The bookkeeping
+        (history, counter) runs under a lock; the visible hand-off is
+        the single `_current` assignment at the end, after the
+        generation is fully recorded."""
+        if generation.published_mono == 0.0:
+            generation = dataclasses.replace(
+                generation, published_mono=time.monotonic())
+        with self._lock:
+            self._history.append((generation.gen, generation.values))
+            del self._history[:-self._HISTORY]
+            self.swaps += 1
+        self._current = generation        # THE atomic swap
+        return generation
+
+    def published(self) -> list[tuple[int, str]]:
+        """Recent (gen, values) publications, oldest first."""
+        with self._lock:
+            return list(self._history)
+
+    def snapshot(self) -> dict:
+        g = self._current
+        return {
+            "swaps": self.swaps,
+            "gen": g.gen if g is not None else 0,
+            "values": g.values[:12] if g is not None else None,
+            "step": g.step if g is not None else None,
+            "staleness_s": (round(g.staleness_s(), 3)
+                            if g is not None else None),
+        }

@@ -1,0 +1,843 @@
+"""stream/: streaming refactorization under drift — the atomic
+resident swap (N threads observe strictly old-or-new, zero torn
+reads), the refine-until-degraded cadence, the contained background
+pipeline (worker death / chaos / guard-breach degrade to continued
+stale serving, never an outage), generation + staleness stamping in
+flight records, the new chaos sites' determinism and off-path
+inertness, and the `scipy.sparse.linalg` drop-in — the pins behind
+DESIGN.md §20."""
+
+import dataclasses
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.obs import flight
+from superlu_dist_tpu.resilience import chaos
+from superlu_dist_tpu.serve import (ServeConfig, ServeError,
+                                    SolveService, StaleFactorError,
+                                    matrix_key, run_stream_load)
+from superlu_dist_tpu.stream import (Cadence, Generation,
+                                     ResidentSwap, StreamConfig,
+                                     StreamLU, splu, spsolve)
+from superlu_dist_tpu.stream import compat as stream_compat
+from superlu_dist_tpu.utils.testmat import laplacian_2d, laplacian_3d
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    """Chaos, flight and the compat pool are process-global; never
+    leak across tests."""
+    chaos.uninstall()
+    flight.configure(enabled=False)
+    yield
+    stream_compat.close()
+    chaos.uninstall()
+    flight.configure(enabled=False)
+
+
+def _svc(**kw):
+    kw.setdefault("backend", "host")
+    return SolveService(ServeConfig(**kw))
+
+
+def _drift(a, step: int, amp: float = 5e-4, seed: int = 0):
+    data = a.data
+    for t in range(1, step + 1):
+        rng = np.random.default_rng(seed * 104729 + t)
+        data = data * (1.0 + amp * rng.standard_normal(data.shape))
+    return dataclasses.replace(a, data=data)
+
+
+def _wait(pred, timeout_s: float = 30.0) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# --------------------------------------------------------------------
+# atomic resident swap
+# --------------------------------------------------------------------
+
+def _gen(i: int, key, lu, a) -> Generation:
+    return Generation(gen=i, key=key, lu=lu, a=a, step=i)
+
+
+def test_swap_readers_observe_strictly_old_or_new():
+    """The tentpole pin: many reader threads hammer `swap.current`
+    while a publisher installs new generations; every observed
+    generation is fully consistent (its fields agree with each other)
+    and was REALLY published (appears in the history, which publish()
+    records BEFORE the visible assignment) — zero torn reads."""
+    a = laplacian_2d(4)
+    key = matrix_key(a, Options())
+    swap = ResidentSwap()
+    swap.publish(_gen(1, key, "lu-1", a))
+    stop = threading.Event()
+    torn: list = []
+    observed: set = set()
+
+    def reader():
+        while not stop.is_set():
+            g = swap.current
+            pub = dict(swap.published())
+            # internal consistency: the frozen dataclass's fields
+            # must agree — lu tag encodes the gen it was built with
+            if g.lu != f"lu-{g.gen}" or g.step != g.gen:
+                torn.append(("fields", g.gen, g.lu))
+            # every visible generation was published first
+            if g.gen not in pub:
+                torn.append(("unpublished", g.gen))
+            observed.add(g.gen)
+
+    threads = [threading.Thread(target=reader) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for i in range(2, 60):
+        swap.publish(_gen(i, key, f"lu-{i}", a))
+        time.sleep(0.001)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not torn
+    assert len(observed) > 1          # readers really saw swaps
+    assert swap.swaps == 59
+    assert swap.current.gen == 59
+
+
+def test_generation_is_frozen_and_tracks_staleness():
+    a = laplacian_2d(4)
+    key = matrix_key(a, Options())
+    g = Generation(gen=1, key=key, lu=None, a=a,
+                   published_mono=time.monotonic())
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        g.gen = 2
+    assert g.values == key.values
+    assert g.staleness_s() >= 0.0
+    assert g.staleness_s(now=g.published_mono + 2.5) == \
+        pytest.approx(2.5)
+
+
+def test_publish_stamps_publication_time():
+    a = laplacian_2d(4)
+    swap = ResidentSwap()
+    g = swap.publish(_gen(1, matrix_key(a, Options()), "lu-1", a))
+    assert g.published_mono > 0.0
+    assert swap.current is g
+
+
+# --------------------------------------------------------------------
+# cadence
+# --------------------------------------------------------------------
+
+def _cadence(**kw):
+    kw.setdefault("trip_frac", 0.25)
+    kw.setdefault("interval_scale", 1.0)
+    kw.setdefault("max_lag", 0)
+    return Cadence(1e-12, **kw)
+
+
+def test_cadence_fresh_never_due():
+    c = _cadence()
+    c.note_berr(1.0, now=0.0)        # way past any threshold
+    assert c.due(lag=0, now=1.0) is None
+
+
+def test_cadence_berr_trip():
+    c = _cadence()
+    c.note_swap(0.5)                  # measured cost: 0.5 s
+    assert c.due(lag=1, now=10.0) is None      # trajectory restarted
+    c.note_berr(0.1e-12, now=10.0)             # under trip (0.25e-12)
+    assert c.due(lag=1, now=10.1) is None
+    c.note_berr(0.3e-12, now=10.2)             # past trip
+    assert c.due(lag=1, now=10.3) == "berr_trip"
+
+
+def test_cadence_drift_lookahead_beats_the_breach():
+    """A rising berr series whose linear fit reaches the trip level
+    within one factorization wall must start the refactor NOW (the
+    overlap-instead-of-chase property)."""
+    c = _cadence()
+    c.note_swap(10.0)                 # a 10 s factorization
+    # slope 0.01e-12/s from 0.05e-12: trip (0.25e-12) in ~20 s > 10 s
+    for i in range(4):
+        c.note_berr((0.05 + 0.01 * i) * 1e-12, now=float(i))
+    assert c.due(lag=1, now=4.0) is None
+    # steeper: trip reached within the 10 s wall
+    c2 = _cadence()
+    c2.note_swap(10.0)
+    for i in range(4):
+        c2.note_berr((0.05 + 0.04 * i) * 1e-12, now=float(i))
+    assert c2.due(lag=1, now=4.0) == "drift"
+
+
+def test_cadence_lag_bound():
+    c = _cadence(max_lag=3)
+    assert c.due(lag=2, now=0.0) is None       # no berr data, lag ok
+    assert c.due(lag=3, now=0.0) == "lag"
+
+
+def test_cadence_min_interval_bounds_duty_cycle():
+    c = _cadence(interval_scale=2.0)
+    c.note_swap(1.0)                  # cost 1 s -> min interval 2 s
+    c.note_refactor_start(now=100.0)
+    c.note_berr(1.0, now=100.5)       # berr screaming past trip
+    assert c.due(lag=1, now=101.0) is None     # inside the window
+    assert c.due(lag=1, now=102.5) == "berr_trip"
+
+
+def test_cadence_swap_restarts_trajectory_and_ewmas_cost():
+    c = _cadence()
+    c.note_swap(4.0)
+    c.note_swap(2.0)
+    assert c.cost_s() == pytest.approx(3.0)    # EWMA, not last
+    c.note_berr(1.0, now=0.0)
+    c.note_swap(1.0)
+    assert c.due(lag=1, now=10.0) is None      # trajectory cleared
+    assert c.snapshot()["points"] == 0
+
+
+# --------------------------------------------------------------------
+# chaos sites: determinism, per-site seeding, off-path inertness
+# --------------------------------------------------------------------
+
+def test_stream_chaos_sites_are_registered():
+    for site in ("refactor_raise", "refactor_slow", "swap_kill"):
+        assert site in chaos.SITES
+
+
+def test_stream_chaos_determinism_and_per_site_seeding():
+    p1 = chaos.install("refactor_raise=0.5,refactor_slow=0.5:0.01",
+                       seed=7)
+    seq_raise = [p1.should("refactor_raise") for _ in range(64)]
+    seq_slow = [p1.should("refactor_slow") for _ in range(64)]
+    chaos.uninstall()
+    p2 = chaos.install("refactor_raise=0.5,refactor_slow=0.5:0.01",
+                       seed=7)
+    assert [p2.should("refactor_raise")
+            for _ in range(64)] == seq_raise
+    assert [p2.should("refactor_slow") for _ in range(64)] == seq_slow
+    chaos.uninstall()
+    # per-site streams: the same seed gives DIFFERENT sequences to
+    # different sites (seeded from (seed, site), not shared)
+    assert seq_raise != seq_slow
+    assert any(seq_raise) and not all(seq_raise)
+    assert p1.param("refactor_slow", 0) == pytest.approx(0.01)
+
+
+def test_stream_chaos_off_path_inert():
+    """Uninstalled (and installed-but-unnamed) sites are no-ops: no
+    raise, no sleep, no SIGKILL — the serve path cost is one pointer
+    check."""
+    assert chaos.active() is None
+    chaos.maybe_raise("refactor_raise", "must not fire")
+    t0 = time.monotonic()
+    chaos.maybe_sleep("refactor_slow")
+    assert time.monotonic() - t0 < 0.25
+    chaos.maybe_sigkill("swap_kill")           # still alive
+    chaos.install("factor_raise=1", seed=0)    # other site only
+    try:
+        chaos.maybe_raise("refactor_raise", "must not fire")
+        chaos.maybe_sigkill("swap_kill")       # still alive
+        assert not chaos.should("swap_kill")
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.slow
+def test_swap_kill_site_kills_by_sigkill():
+    """swap_kill really dies by SIGKILL at the call site — the drill
+    relies on rc == -SIGKILL to prove the victim died mid-swap."""
+    code = ("from superlu_dist_tpu.resilience import chaos\n"
+            "chaos.install('swap_kill=1', seed=0)\n"
+            "chaos.maybe_sigkill('swap_kill')\n"
+            "print('SURVIVED')\n")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=600,
+                       env={"JAX_PLATFORMS": "cpu",
+                            "PATH": "/usr/bin:/bin:/usr/local/bin"})
+    assert r.returncode == -signal.SIGKILL
+    assert "SURVIVED" not in r.stdout
+
+
+# --------------------------------------------------------------------
+# pipeline: prime, update, background swap, containment
+# --------------------------------------------------------------------
+
+def test_stream_prime_serves_fresh_then_rides_stale():
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        assert h.swap.current.gen == 1
+        b = np.random.default_rng(0).standard_normal(a.n)
+        x = np.asarray(h.solve(b))
+        assert np.isfinite(x).all()
+        assert svc.metrics.counter("stream.fresh_solves") == 1
+        a2 = _drift(a, 1)
+        h.update(a2)
+        st = h.status()
+        assert st["lag"] == 1 and not st["fresh"]
+        x2 = np.asarray(h.solve(b))
+        # the stale solve refines against the LIVE matrix — the
+        # answer is the drifted system's, inside the berr class
+        r = np.abs(a2.to_scipy() @ x2 - b).max()
+        assert r < 1e-10
+        assert svc.metrics.counter("stream.stale_solves") == 1
+        assert svc.metrics.counter("stream.refactors") == 0
+    finally:
+        svc.close()
+
+
+def test_stream_background_swap_publishes_fresh_generation():
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0))
+        h.update(_drift(a, 1))
+        h.refactor_now()
+        assert _wait(lambda: h.status()["fresh"])
+        st = h.status()
+        assert st["gen"] == 2 and st["lag"] == 0
+        assert h.swap.swaps == 2
+        b = np.ones(a.n)
+        assert np.isfinite(np.asarray(h.solve(b))).all()
+        # fresh solves after the swap ride the new generation plainly
+        assert svc.metrics.counter("stream.swaps") == 1
+    finally:
+        svc.close()
+
+
+def test_stream_update_rejects_pattern_change():
+    svc = _svc()
+    try:
+        h = svc.stream(laplacian_3d(4), None,
+                       StreamConfig(background=False))
+        with pytest.raises(ValueError, match="pattern"):
+            h.update(laplacian_2d(7))
+    finally:
+        svc.close()
+
+
+def test_stream_refactor_failure_degrades_to_stale_serving():
+    """refactor_raise kills every background factorization: solves
+    keep riding the stale generation (correct answers, never an
+    outage), the failure is counted, and recovery swaps once chaos
+    lifts."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0))
+        chaos.install("refactor_raise=1", seed=0)
+        a2 = _drift(a, 1)
+        h.update(a2)
+        h.refactor_now()
+        assert _wait(lambda: svc.metrics.counter(
+            "stream.refactor_failures") >= 1)
+        b = np.ones(a.n)
+        x = np.asarray(h.solve(b))
+        assert np.abs(a2.to_scipy() @ x - b).max() < 1e-10
+        assert h.status()["gen"] == 1              # still stale
+        assert h.status()["worker_alive"]          # worker survived
+        chaos.uninstall()
+        h.refactor_now()
+        assert _wait(lambda: h.status()["fresh"])
+        assert h.status()["gen"] == 2
+    finally:
+        svc.close()
+
+
+def test_stream_worker_death_is_contained_and_restartable():
+    """A BaseException escaping the loop (beyond the per-refactor
+    Exception containment) marks the worker dead; serving continues;
+    the next request restarts the worker — the replace-dead-batcher
+    discipline."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0))
+        real = h._refactor_once
+        h._refactor_once = lambda *aa, **kw: (_ for _ in ()).throw(
+            KeyboardInterrupt("die"))
+        h.update(_drift(a, 1))
+        h.refactor_now()
+        assert _wait(lambda: h.status()["worker_dead"] is not None)
+        assert svc.metrics.counter("stream.worker_died") == 1
+        # serving continues on the resident generation
+        assert np.isfinite(np.asarray(h.solve(np.ones(a.n)))).all()
+        # next request restarts the worker and completes the swap
+        h._refactor_once = real
+        h.refactor_now()
+        assert _wait(lambda: h.status()["fresh"])
+        assert svc.metrics.counter("stream.worker_restarts") == 1
+        assert h.status()["worker_alive"]
+    finally:
+        svc.close()
+
+
+def test_stream_guard_breach_is_typed_blocked_and_never_served():
+    """A stale solve whose refined berr leaves the accuracy class
+    fails TYPED (StaleFactorError — no result escapes the guard),
+    blocks those values from further stale serving, and a fresher
+    generation clears the block."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        a2 = _drift(a, 1)
+        h.update(a2)
+        h.cadence.guard_limit = 1e-300     # any berr breaches now
+        b = np.ones(a.n)
+        with pytest.raises(StaleFactorError, match="accuracy class"):
+            h.solve(b)
+        assert svc.metrics.counter("stream.guard_breaches") == 1
+        assert h.status()["blocked_values"] == 1
+        # blocked values fail fast (no doomed refinement re-burn)
+        with pytest.raises(StaleFactorError, match="blocked"):
+            h.solve(b)
+        assert svc.metrics.counter("stream.blocked_rejects") == 1
+        # a fresh generation clears the block: publish one manually
+        # (background is off) the way _refactor_once does
+        h.cadence.guard_limit = 1e-10
+        key2 = matrix_key(a2, h.options)
+        lu2 = svc.cache.get_or_factorize(a2, h.options, key=key2)
+        with h._cond:
+            h._blocked_values.clear()
+        h.swap.publish(Generation(gen=2, key=key2, lu=lu2, a=a2,
+                                  step=1))
+        assert np.isfinite(np.asarray(h.solve(b))).all()
+    finally:
+        svc.close()
+
+
+def test_probe_refused_generation_is_quarantined(monkeypatch,
+                                                 tmp_path):
+    """Write-through precedes validation, so a probe-refused
+    generation is already durable + cache-resident: the refusal must
+    evict and quarantine it, or restarts/siblings/retries adopt the
+    factors the probe rejected."""
+    import superlu_dist_tpu.stream.pipeline as pl
+    svc = SolveService(ServeConfig(backend="host",
+                                   store_dir=str(tmp_path)))
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0))
+        monkeypatch.setattr(
+            pl, "_solve",
+            lambda lu, b, **kw: np.full(np.asarray(b).shape, np.nan))
+        a2 = _drift(a, 1)
+        key2 = h.update(a2)
+        h.refactor_now()
+        assert _wait(
+            lambda: h.status()["refactor_failures"] >= 1)
+        assert h.status()["gen"] == 1          # never published
+        assert svc.cache.peek(key2, touch=False) is None
+        assert not svc.cache.store.contains(key2)
+    finally:
+        svc.close()
+
+
+def test_stale_request_for_already_published_values_is_dropped():
+    """Every stale solve re-requests until the swap lands; a want
+    popped AFTER the swap covered those values must not factor (and
+    publish) a duplicate generation."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0))
+        a2 = _drift(a, 1)
+        key2 = h.update(a2)
+        h.refactor_now()
+        assert _wait(lambda: h.status()["fresh"])
+        swaps, refactors = h.swap.swaps, h.status()["refactors"]
+        h._request(key2, a2, 1, "stale")
+        time.sleep(0.3)
+        assert h.swap.swaps == swaps
+        assert h.status()["refactors"] == refactors
+    finally:
+        svc.close()
+
+
+def test_stream_close_is_idempotent_and_terminal():
+    svc = _svc()
+    a = laplacian_3d(4)
+    h = svc.stream(a, None, StreamConfig(background=True))
+    h.close()
+    h.close()
+    with pytest.raises(ServeError, match="closed"):
+        h.update(_drift(a, 1))
+    svc.close()                       # closes remaining streams too
+
+
+def test_service_close_closes_streams():
+    svc = _svc()
+    a = laplacian_3d(4)
+    h = svc.stream(a, None, StreamConfig(background=True))
+    svc.close()
+    with pytest.raises(ServeError):
+        h.update(_drift(a, 1))
+
+
+def test_stream_open_racing_close_never_leaks_a_handle(monkeypatch):
+    """close() landing inside stream()'s synchronous prime must not
+    leave the new handle (and its background worker) untracked: the
+    open fails typed and the handle is closed, not leaked."""
+    svc = _svc()
+    a = laplacian_3d(4)
+    orig = svc.cache.get_or_factorize
+
+    def closing(*args, **kw):
+        lu = orig(*args, **kw)
+        svc.close()        # lands between the prime and registration
+        return lu
+
+    monkeypatch.setattr(svc.cache, "get_or_factorize", closing)
+    with pytest.raises(ServeError, match="closed"):
+        svc.stream(a, None, StreamConfig(background=True))
+    assert not any(t.name == "slu-stream-refactor" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_stream_survives_resident_cache_eviction():
+    """The shared cache LRU-evicting the resident key under other
+    traffic must not strand the stream: the Generation holds its
+    factors alive, so the route re-publishes them and serves —
+    fresh leg and stale (guarded, refine-against-live) leg both."""
+    svc = _svc(capacity_bytes=1)      # any insert evicts the rest
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        svc.prefactor(laplacian_2d(9))            # evicts the stream
+        assert svc.cache.peek(h.swap.current.key, touch=False) is None
+        b = np.ones(a.n)
+        assert np.isfinite(np.asarray(h.solve(b))).all()
+        assert svc.metrics.counter("stream.resident_reputs") == 1
+        h.update(_drift(a, 1))
+        svc.prefactor(laplacian_2d(10))           # evicts it again
+        x = np.asarray(h.solve(b))                # stale leg
+        assert np.isfinite(x).all()
+        assert svc.metrics.counter("stream.resident_reputs") == 2
+        assert svc.metrics.counter("stream.stale_solves") == 1
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------
+# flight stamping: generation + staleness on every stream solve
+# --------------------------------------------------------------------
+
+def _route_events(recorder):
+    evs = []
+    for rec in recorder.records():
+        evs += [(rec, e) for e in rec["events"]
+                if e["stage"] == "stream.route"]
+    return evs
+
+
+def test_flight_records_stamp_generation_and_staleness():
+    flight.configure(enabled=True, ring=256)
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        b = np.ones(a.n)
+        h.solve(b)                     # fresh, gen 1
+        h.update(_drift(a, 1))
+        h.solve(b)                     # stale, gen 1, lag 1
+        evs = _route_events(flight.get_recorder())
+        assert len(evs) == 2
+        (r1, e1), (r2, e2) = evs
+        assert e1["gen"] == 1 and e1["fresh"] is True
+        assert e1["staleness_ms"] >= 0 and e1["lag"] == 0
+        assert e2["gen"] == 1 and e2["fresh"] is False
+        assert e2["lag"] == 1
+        # outcome + served-from annotation land on the record itself
+        assert r2["outcome"] == "ok"
+        assert r2["meta"].get("served") == "stream"
+    finally:
+        svc.close()
+        flight.configure(enabled=False)
+
+
+def test_swap_under_concurrent_solves_strictly_old_or_new():
+    """The satellite pin, end to end: N threads solving through one
+    handle while swaps publish — every solve lands on a REAL
+    published generation (flight gen stamps ⊆ swap history), all
+    results are finite/correct-for-their-system, zero torn reads."""
+    flight.configure(enabled=True, ring=2048, sample=1)
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0,
+                                             max_lag=1))
+        stop = threading.Event()
+        failures: list = []
+
+        def solver(wid: int):
+            rng = np.random.default_rng(wid)
+            while not stop.is_set():
+                b = rng.standard_normal(a.n)
+                try:
+                    x = np.asarray(h.solve(b))
+                    if not np.isfinite(x).all():
+                        failures.append((wid, "nonfinite"))
+                except StaleFactorError:
+                    failures.append((wid, "guard"))
+                except Exception as e:      # noqa: BLE001
+                    failures.append((wid, repr(e)))
+
+        threads = [threading.Thread(target=solver, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for step in range(1, 6):
+            h.update(_drift(a, step))
+            _wait(lambda: h.status()["fresh"], timeout_s=30.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not failures
+        st = h.status()
+        assert st["gen"] >= 2          # swaps really happened
+        published = {g for g, _ in h.swap.published()}
+        gens = {e["gen"] for _, e in
+                _route_events(flight.get_recorder())}
+        assert gens <= published       # only ever-published gens
+        assert len(gens) >= 2          # solves observed a swap
+    finally:
+        svc.close()
+        flight.configure(enabled=False)
+
+
+# --------------------------------------------------------------------
+# transient-sim loadgen
+# --------------------------------------------------------------------
+
+def test_run_stream_load_journals_and_accounts_every_request(
+        tmp_path):
+    import json
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=True,
+                                             interval_scale=0.0,
+                                             max_lag=2))
+        journal = str(tmp_path / "journal.jsonl")
+        rep = run_stream_load(
+            [(h, lambda t: _drift(a, t))],
+            steps=4, step_hz=20.0, requests=24, concurrency=4,
+            rate_hz=120.0, seed=3, journal_path=journal)
+        assert rep["unresolved"] == 0
+        assert rep["by_status"] == {"ok": 24}
+        assert rep["completed_indices"] == list(range(24))
+        assert rep["stream"]["guard_breaches"] == 0
+        lines = [json.loads(ln) for ln in
+                 open(journal).read().splitlines()]
+        assert sorted(d["i"] for d in lines) == list(range(24))
+        assert all(d["status"] == "ok" for d in lines)
+        # the replay contract: a sparse index list is honored exactly
+        rep2 = run_stream_load(
+            [(h, lambda t: _drift(a, t))],
+            steps=1, step_hz=50.0, requests=24, concurrency=2,
+            seed=3, indices=[3, 11, 17])
+        assert rep2["completed_indices"] == [3, 11, 17]
+    finally:
+        svc.close()
+
+
+def test_run_stream_load_heals_torn_journal(tmp_path):
+    """A SIGKILLed predecessor leaves a torn final line; the next
+    writer must not concatenate onto it — the fragment stays its own
+    (unparseable, replayed) line and every appended record parses."""
+    import json
+    journal = tmp_path / "journal.jsonl"
+    journal.write_text('{"i": 0, "status": "ok", "ms": 1.0}\n'
+                       '{"i": 1, "sta')
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        rep = run_stream_load(
+            [(h, lambda t: _drift(a, t))],
+            steps=1, step_hz=50.0, requests=4, concurrency=2,
+            seed=3, indices=[1, 2], journal_path=str(journal))
+        assert rep["completed_indices"] == [1, 2]
+        parsed, torn = [], 0
+        for ln in journal.read_text().splitlines():
+            try:
+                parsed.append(json.loads(ln)["i"])
+            except ValueError:
+                torn += 1
+        assert torn == 1
+        assert sorted(parsed) == [0, 1, 2]
+    finally:
+        svc.close()
+
+
+def test_refactor_now_works_on_a_pinned_stream():
+    """The manual lever must not be a silent no-op when background
+    cadence is off: it starts a worker for the one-shot request."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        h.update(_drift(a, 1))
+        assert not h.status()["worker_alive"]
+        h.refactor_now()
+        assert _wait(lambda: h.status()["fresh"])
+        assert h.status()["gen"] == 2
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------
+# scipy.sparse.linalg drop-in
+# --------------------------------------------------------------------
+
+def _compat_svc():
+    svc = _svc()
+    stream_compat.configure(
+        service=svc,
+        stream_config=StreamConfig(background=False))
+    return svc
+
+
+def test_closed_stream_refuses_live_solves_but_named_systems_serve():
+    """A closed stream can never swap, so live-path solves (drift
+    ahead) refuse typed; a compat StreamLU's NAMED system stays
+    solvable — frozen generation, fixed values, berr cannot drift."""
+    svc = _svc()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, None, StreamConfig(background=False))
+        key = matrix_key(a, h.options)
+        h.close()
+        with pytest.raises(ServeError, match="closed"):
+            h.solve(np.ones(a.n))
+        x = np.asarray(h.solve(np.ones(a.n), against=(key, a)))
+        assert np.isfinite(x).all()
+    finally:
+        svc.close()
+
+
+def test_compat_pool_streams_register_with_the_service():
+    """splu's pooled handles go through the service front door:
+    service.close() closes them like any svc.stream() handle."""
+    svc = _compat_svc()
+    try:
+        a = laplacian_3d(4)
+        lu = splu(a)
+        handle = lu._handle
+        assert handle in svc._streams
+        svc.close()
+        with pytest.raises(ServeError):
+            handle.update(_drift(a, 1))
+    finally:
+        stream_compat.close()
+        svc.close()
+
+
+def test_splu_solves_like_scipy():
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    svc = _compat_svc()
+    try:
+        a = laplacian_3d(4)
+        A = scipy_sparse.csr_matrix(
+            (a.data, a.indices, a.indptr), shape=(a.m, a.n))
+        lu = splu(A)
+        assert isinstance(lu, StreamLU)
+        assert lu.shape == (a.n, a.n) and lu.nnz == len(a.data)
+        b = np.random.default_rng(0).standard_normal(a.n)
+        x = lu.solve(b)
+        assert np.abs(A @ x - b).max() < 1e-10
+        xt = lu.solve(b, trans="T")
+        assert np.abs(A.T @ xt - b).max() < 1e-10
+        B = np.random.default_rng(1).standard_normal((a.n, 3))
+        X = lu.solve(B)
+        assert X.shape == (a.n, 3)
+        assert np.abs(A @ X - B).max() < 1e-10
+        assert len(lu.perm_r) == a.n and len(lu.perm_c) == a.n
+        assert lu.stream_status()["gen"] >= 1
+    finally:
+        stream_compat.close()
+        svc.close()
+
+
+def test_splu_streams_drifted_values_without_refactoring_inline():
+    """The economics pin: the second splu on a drifted matrix returns
+    immediately (no inline factorization), its solve rides the stale
+    generation with refinement, and BOTH handles keep solving THEIR
+    OWN system."""
+    svc = _compat_svc()
+    try:
+        a1 = laplacian_3d(4)
+        a2 = _drift(a1, 1)
+        lu1 = splu(a1)
+        fact0 = svc.cache.stats()["factorizations"]
+        lu2 = splu(a2)                 # same pattern: same stream
+        assert svc.cache.stats()["factorizations"] == fact0
+        assert lu2._handle is lu1._handle
+        b = np.ones(a1.n)
+        x2 = lu2.solve(b)
+        assert np.abs(a2.to_scipy() @ x2 - b).max() < 1e-10
+        # the OLD handle still refines against ITS system even
+        # though the stream stepped on
+        x1 = lu1.solve(b)
+        assert np.abs(a1.to_scipy() @ x1 - b).max() < 1e-10
+    finally:
+        stream_compat.close()
+        svc.close()
+
+
+def test_spsolve_and_input_validation():
+    svc = _compat_svc()
+    try:
+        a = laplacian_3d(4)
+        b = np.ones(a.n)
+        x = spsolve(a, b)
+        assert np.abs(a.to_scipy() @ x - b).max() < 1e-10
+        with pytest.raises(TypeError, match="permc_spec"):
+            splu(a, permc_spec="COLAMD")
+        with pytest.raises(TypeError, match="splu expects"):
+            splu(np.eye(4))
+        lu = splu(a)
+        with pytest.raises(ValueError, match="trans"):
+            lu.solve(b, trans="X")
+        with pytest.raises(ValueError, match="b must be"):
+            lu.solve(np.ones((a.n, 2, 2)))
+    finally:
+        stream_compat.close()
+        svc.close()
+
+
+def test_compat_pool_is_bounded_lru():
+    svc = _compat_svc()
+    try:
+        base = laplacian_2d(5)
+        lu = splu(base)
+        h_base = lu._handle
+        # hammer distinct patterns past the pool cap; the base
+        # pattern is touched each round and must survive
+        for k in range(stream_compat._MAX_STREAMS + 2):
+            splu(laplacian_2d(6 + k))
+            splu(base)
+        assert len(stream_compat._pool) <= stream_compat._MAX_STREAMS
+        assert splu(base)._handle is h_base
+    finally:
+        stream_compat.close()
+        svc.close()

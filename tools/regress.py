@@ -28,6 +28,12 @@ tolerance:
                  fleet_factorizations_per_cold_key == 1,
                  takeover_factorizations == 0, gate.passed
                  (the multi-process drill record, FLEET.jsonl)
+  * stream     — drift drill (serve_bench --stream): lost == 0,
+                 hung == 0, unresolved == 0, guard_breaches == 0
+                 (no result ever served past the berr guard),
+                 swaps >= 1, overlap_ratio <= the declared ceiling
+                 (stream p99 within 1.10x of the pinned arm — the
+                 background refactor provably overlaps), gate.passed
   * bench      — GFLOP/s floor
 
 Usage:
@@ -74,6 +80,9 @@ DEFAULT_TOLERANCES = {
     # flight-recorder on/off throughput gap (the ISSUE-8 overhead
     # acceptance: within 5% on a same-box same-moment A/B)
     "flight_overhead_frac": 0.05,
+    # stream drill: steady-state p99 of the background-refactor arm
+    # over the pinned arm (the ISSUE-13 overlap acceptance)
+    "stream_overlap_ratio": 1.10,
 }
 
 
@@ -158,6 +167,8 @@ def gather(root: str) -> dict:
             add(rec.get("platform"), "flight_ab", rec)
         elif mode == "cold_boot":
             add(rec.get("platform"), "cold_boot", rec)
+        elif mode == "stream":
+            add(rec.get("platform"), "stream", rec)
     for rec in _read_jsonl(os.path.join(root, "SOLVE_LATENCY.jsonl")):
         if rec.get("mode") == "factor_ab":
             # staged factor A/B records (bench.py --factor-ab): gate
@@ -397,6 +408,49 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the fleet drill gate itself "
                     "failed"))
+            elif chk == "stream":
+                for m, why in (
+                        ("lost", "a drill request was lost across "
+                         "the kill -9 + restart (no journal "
+                         "outcome)"),
+                        ("hung", "a drill worker hung"),
+                        ("unresolved", "an overlap-A/B request "
+                         "never produced a status"),
+                        ("guard_breaches", "a result was served "
+                         "past the stream berr guard"),
+                        ("stale_rejected", "stale-factor refinement "
+                         "left the accuracy class under the drill's "
+                         "calibrated drift")):
+                    zero_check(p, chk, m, _num(latest, m), why)
+                v = _num(latest, "swaps")
+                if v is not None:
+                    ok = v >= 1
+                    findings.append(_finding(
+                        p, chk, "swaps", v, 1, 1,
+                        "ok" if ok else "fail",
+                        "" if ok else "the background pipeline never "
+                        "published a resident swap"))
+                v = _num(latest, "overlap_ratio")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "overlap_ratio", None, None, None,
+                        "skip", "metric absent"))
+                else:
+                    limit = tol["stream_overlap_ratio"]
+                    ok = v <= limit
+                    findings.append(_finding(
+                        p, chk, "overlap_ratio", v, 1.0, limit,
+                        "ok" if ok else "fail",
+                        "" if ok else "background refactorization "
+                        "stole the serving path's p99 (overlap "
+                        "broken)"))
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the stream drill gate itself "
+                    "failed"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -456,6 +510,8 @@ def build_baselines(history: dict, tolerances: dict | None = None,
             elif chk == "chaos":
                 dst[chk] = {}
             elif chk == "fleet":
+                dst[chk] = {}          # structural zero-gates only
+            elif chk == "stream":
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "bench":
                 dst[chk] = {"gflops": _median(

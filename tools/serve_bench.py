@@ -682,6 +682,462 @@ def run_cold_boot(argv=(), k=None, requests=None, out_path=None):
     return rec
 
 
+# --------------------------------------------------------------------
+# streaming refactorization drill (ISSUE 13): --stream
+# --------------------------------------------------------------------
+
+# default chaos for the kill-drill child: background-factor failures
+# (raise + slow) AND the mid-swap kill -9, all at once
+STREAM_CHAOS_SPEC = ("refactor_raise=0.25,refactor_slow=0.4:0.05,"
+                     "swap_kill=1")
+
+
+def _stream_params():
+    return {
+        "k": int(os.environ.get("SLU_SERVE_K", "8")),
+        "concurrency": int(os.environ.get("SLU_SERVE_CONCURRENCY",
+                                          "8")),
+        # 192 (vs the serve drill's 96): the overlap gate reads p99
+        # off each arm's ok-latency set — at 96 paced requests p99 is
+        # the single worst sample and one unlucky swap collision
+        # decides the gate; 192 makes it a real percentile
+        "requests": int(os.environ.get("SLU_SERVE_REQUESTS", "192")),
+        "steps": int(os.environ.get("SLU_STREAM_STEPS", "24")),
+        "step_hz": float(os.environ.get("SLU_STREAM_STEP_HZ", "4")),
+        # calibrated: at 5e-4/step a 24-step walk refines to ~2e-16
+        # berr off the PINNED generation-1 factors — two decades
+        # inside the 64·eps class; 2e-3 breaches the guard by step ~8
+        # (measured, 3D Laplacian) — the drill proves refinement
+        # covers the drift, not that the guard fires
+        "drift": float(os.environ.get("SLU_STREAM_DRIFT", "5e-4")),
+        "trials": int(os.environ.get("SLU_STREAM_TRIALS", "3")),
+        "tol": float(os.environ.get("SLU_STREAM_OVERLAP_TOL",
+                                    "1.10")),
+    }
+
+
+def _drift_values(a, step: int, drift: float, seed: int):
+    """Deterministic per-step drifted values: a multiplicative random
+    walk of amplitude `drift` per step (seeded by (seed, step) alone,
+    so a restarted child regenerates the identical sequence)."""
+    import dataclasses as _dc
+    data = a.data
+    for t in range(1, step + 1):
+        rng = np.random.default_rng(seed * 104729 + t)
+        data = data * (1.0 + drift * rng.standard_normal(data.shape))
+    return _dc.replace(a, data=data)
+
+
+def _stream_arm(svc, a, p, *, background: bool, seed: int,
+                indices=None, journal_path=None, start_step: int = 0,
+                join_timeout_s=None):
+    """One transient-sim load pass on a FRESH StreamHandle.  The
+    drift sequence is deterministic in `seed`; `start_step` lets the
+    restart child resume the walk where the killed child's store
+    left off."""
+    from superlu_dist_tpu.serve import run_stream_load
+    from superlu_dist_tpu.stream import StreamConfig
+
+    base = (_drift_values(a, start_step, p["drift"], seed)
+            if start_step else a)
+    fact_before = svc.cache.stats()["factorizations"]
+    h = svc.stream(base, None,
+                   StreamConfig(background=background,
+                                # drill scale: swaps are LAG-forced
+                                # (the calibrated drift never trips
+                                # the berr cadence by design), so the
+                                # swap rate here is a drill choice.
+                                # max_lag=16 at 4 Hz = a swap per 4 s
+                                # (~1.5/window): a refactor's ~50 ms
+                                # hot window slows colliding solves
+                                # ~2x on the shared XLA:CPU pool
+                                # (measured; DESIGN §20), so the
+                                # drill holds the background duty
+                                # cycle ~1% the way a real cadence's
+                                # interval_scale would — max_lag=4's
+                                # swap-per-second puts 5%+ of paced
+                                # requests inside hot windows and p99
+                                # reads the collision, not the
+                                # steady state
+                                interval_scale=0.0, max_lag=16))
+    prime_factorizations = (svc.cache.stats()["factorizations"]
+                            - fact_before)
+    try:
+        # pace the load to SPAN the drift window (requests spread
+        # over the steps) — an unpaced drain would finish while every
+        # value set is still fresh and measure no streaming at all
+        n_req = len(indices) if indices is not None else p["requests"]
+        steps_left = max(1, p["steps"] - start_step)
+        rate = n_req * p["step_hz"] / steps_left
+        rep = run_stream_load(
+            [(h, lambda t: _drift_values(a, start_step + t,
+                                         p["drift"], seed))],
+            steps=p["steps"] - start_step, step_hz=p["step_hz"],
+            requests=p["requests"], concurrency=p["concurrency"],
+            seed=seed, rate_hz=rate, indices=indices,
+            journal_path=journal_path,
+            join_timeout_s=join_timeout_s)
+        rep["status"] = h.status()
+        rep["prime_factorizations"] = prime_factorizations
+    finally:
+        h.close()
+    return rep
+
+
+def run_stream_child(k: int, steps: int, requests: int, drift: float,
+                     seed: int, journal_path: str) -> dict:
+    """Kill-drill child: stream load under SLU_CHAOS (background
+    refactor failures + the mid-swap `swap_kill`) against the shared
+    SLU_FT_STORE, journaling every completed request.  Under
+    swap_kill=1 this process DIES BY SIGKILL at its first resident
+    swap — the RESULT line only appears if chaos never killed it
+    (the parent treats that as a drill failure)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.resilience import chaos
+    from superlu_dist_tpu.serve import ServeConfig, SolveService
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    _jax_env()
+    chaos.install_from_env()
+    p = _stream_params()
+    p.update(k=k, steps=steps, requests=requests, drift=drift)
+    a = laplacian_3d(k)
+    svc = SolveService(ServeConfig(
+        max_queue_depth=max(64, 4 * requests), factor_retries=1,
+        retry_base_s=0.01, breaker_threshold=4,
+        breaker_cooldown_s=0.5))
+    rep = _stream_arm(svc, a, p, background=True, seed=seed,
+                      journal_path=journal_path,
+                      join_timeout_s=600.0)
+    svc.close()
+    rec = {"by_status": rep["by_status"],
+           "unresolved": rep["unresolved"],
+           "swaps": rep["stream"]["swaps"]}
+    print("RESULT " + json.dumps(rec))
+    return rec
+
+
+def run_stream_restart_child(k: int, steps: int, requests: int,
+                             drift: float, seed: int,
+                             journal_path: str) -> dict:
+    """Restart child: boot against the killed child's store, prime
+    from WHICHEVER generation the store last published (scan the
+    deterministic drift walk newest-first), assert the prime paid no
+    factorization (warm-generation restart), then complete every
+    journal index the killed child never resolved."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.serve import ServeConfig, SolveService
+    from superlu_dist_tpu.serve.factor_cache import matrix_key
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    _jax_env()
+    p = _stream_params()
+    p.update(k=k, steps=steps, requests=requests, drift=drift)
+    a = laplacian_3d(k)
+    svc = SolveService(ServeConfig(
+        max_queue_depth=max(64, 4 * requests)))
+    store = svc.cache.store
+    assert store is not None, "restart child needs SLU_FT_STORE"
+    # whichever generation the store last published: the drift walk
+    # is deterministic, so scan it newest-first for a durable entry
+    prime_step = 0
+    for t in range(steps, -1, -1):
+        key_t = matrix_key(_drift_values(a, t, drift, seed))
+        if store.contains(key_t):
+            prime_step = t
+            break
+    done = set()
+    with open(journal_path) as f:
+        for line in f:
+            try:
+                done.add(int(json.loads(line)["i"]))
+            except (ValueError, KeyError):
+                continue
+    missing = [i for i in range(requests) if i not in done]
+    rep = _stream_arm(svc, a, p, background=True, seed=seed,
+                      indices=missing, journal_path=journal_path,
+                      start_step=prime_step, join_timeout_s=600.0)
+    st = svc.cache.stats()
+    rec = {
+        "prime_step": prime_step,
+        "factorizations_at_prime": rep["prime_factorizations"],
+        "factorizations": st["factorizations"],
+        "store_hits": st["store_hits"],
+        "replayed": len(missing),
+        "by_status": rep["by_status"],
+        "unresolved": rep["unresolved"],
+        "guard_breaches": rep["stream"]["guard_breaches"],
+    }
+    svc.close()
+    print("RESULT " + json.dumps(rec))
+    return rec
+
+
+def run_stream(argv=()):
+    """The ISSUE-13 drift drill: (a) steady-state OVERLAP A/B — the
+    same transient-sim load with the background refactor pipeline ON
+    vs PINNED (no refactor, refine-only), interleaved pairs with
+    alternating order, gating the POOLED-across-trials p99 ratio at
+    SLU_STREAM_OVERLAP_TOL (1.10: overlap proven — background
+    factorization does not steal the serving path's tail); (b) the
+    KILL DRILL — a child process under refactor_raise/refactor_slow
+    chaos plus swap_kill=1 dies by kill -9 MID-SWAP, the restart
+    child boots warm from whichever generation the shared store last
+    published (factorizations == 0 at prime) and completes every
+    request the victim left unresolved (zero lost fleet-wide).
+    Appends one mode="stream" line to SLU_SERVE_OUT and runs the
+    regression sentinel; a failed gate stamps measurement_invalid,
+    persists nothing, and exits 1."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    repo, dev = _jax_env()
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.serve import ServeConfig, SolveService
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    flight, slo = _observability_on()
+    p = _stream_params()
+    out_path = os.environ.get(
+        "SLU_SERVE_OUT", os.path.join(repo, "SERVE_LATENCY.jsonl"))
+    a = laplacian_3d(p["k"])
+    print(f"# stream drill: n={a.n} (k={p['k']}) steps={p['steps']} "
+          f"drift={p['drift']}", file=sys.stderr)
+
+    # --- phase 1: overlap A/B (in-process, interleaved pairs) ---
+    svc = SolveService(ServeConfig(
+        max_queue_depth=max(64, 4 * p["requests"])))
+    svc.prefactor(a, Options())      # shared warm base + jit warmup
+    # one UNMEASURED pair first: the first run of each arm pays
+    # one-time costs (stale-variant program warmup, the worker's
+    # first probe) that a steady-state comparison must not count
+    for warm_arm in (False, True):
+        _stream_arm(svc, a, p, background=warm_arm, seed=999)
+    arms: dict = {"pinned": [], "stream": []}
+    ratios = []
+    breaches = rejected = 0
+    swaps_total = 0
+    for t in range(p["trials"]):
+        order = (("pinned", "stream") if t % 2 == 0
+                 else ("stream", "pinned"))
+        pair = {}
+        for arm in order:
+            rep = _stream_arm(svc, a, p, background=(arm == "stream"),
+                              seed=1000 + t)
+            pair[arm] = rep
+            arms[arm].append(rep)
+            if arm == "stream":
+                swaps_total += rep["stream"]["swaps"]
+            print(f"# trial {t} {arm}: p99={rep.get('p99_ms', 0):.1f}"
+                  f"ms ok={rep['by_status'].get('ok', 0)}"
+                  f" swaps={rep['stream']['swaps']}", file=sys.stderr)
+        # per-run deltas summed over MEASURED runs only: the
+        # cumulative service counter would fail the zero-gate on a
+        # breach in the deliberately unmeasured warmup pair
+        breaches = sum(r["stream"]["guard_breaches"]
+                       for rs in arms.values() for r in rs)
+        rejected += sum(r["by_status"].get("stale_rejected", 0)
+                        for r in pair.values())
+        if pair["pinned"].get("p99_ms") and pair["stream"].get(
+                "p99_ms"):
+            ratios.append(pair["stream"]["p99_ms"]
+                          / pair["pinned"]["p99_ms"])
+    svc.close()
+    # THE overlap measurement: pooled ok latencies across all trials
+    # per arm (trials x requests samples) — a per-pair p99 ratio is
+    # decided by each run's worst ~2 samples and flips on scheduler
+    # noise (observed pair ratios 0.85-1.50 on one green config);
+    # the pooled p99 is a real percentile of the steady state.  The
+    # per-pair ratios stay in the record for transparency.
+    from superlu_dist_tpu.serve.metrics import nearest_rank
+    pooled = {arm: np.array(sorted(
+        ms for r in reps for ms in r.get("ok_ms", [])))
+        for arm, reps in arms.items()}
+    overlap_ratio = None
+    if len(pooled["pinned"]) and len(pooled["stream"]):
+        overlap_ratio = (nearest_rank(pooled["stream"], 99)
+                         / nearest_rank(pooled["pinned"], 99))
+    unresolved = sum(r["unresolved"] for rs in arms.values()
+                     for r in rs)
+    nonfinite = sum(r["by_status"].get("nonfinite", 0)
+                    for rs in arms.values() for r in rs)
+    untyped = sum(r["by_status"].get("error", 0)
+                  for rs in arms.values() for r in rs)
+
+    # --- phase 2: the kill drill (subprocesses on one store) ---
+    store_dir = tempfile.mkdtemp(prefix="slu_stream_store_")
+    jdir = tempfile.mkdtemp(prefix="slu_stream_journal_")
+    journal = os.path.join(jdir, "journal.jsonl")
+    drill_seed = int(os.environ.get("SLU_CHAOS_SEED", "0") or "0")
+
+    def child(kind, extra_env):
+        env = dict(os.environ)
+        env["SLU_FT_STORE"] = store_dir
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        env.update(extra_env)
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), kind,
+             str(p["k"]), str(p["steps"]), str(p["requests"]),
+             str(p["drift"]), str(drill_seed), journal],
+            env=env, capture_output=True, text=True, timeout=3600)
+
+    try:
+        print("# stream drill: victim child (chaos + swap_kill) ...",
+              file=sys.stderr)
+        spec = os.environ.get("SLU_CHAOS", "").strip() \
+            or STREAM_CHAOS_SPEC
+        victim = child("--stream-child", {"SLU_CHAOS": spec})
+        killed_by_sigkill = victim.returncode == -signal.SIGKILL
+        if not killed_by_sigkill:
+            print(victim.stderr[-3000:], file=sys.stderr)
+        with open(journal) as f:
+            victim_done = sum(1 for _ in f)
+        print(f"# victim rc={victim.returncode} "
+              f"(SIGKILL={killed_by_sigkill}), "
+              f"{victim_done}/{p['requests']} journaled",
+              file=sys.stderr)
+        print("# stream drill: restart child (warm takeover) ...",
+              file=sys.stderr)
+        restart = child("--stream-restart-child", {"SLU_CHAOS": ""})
+        if restart.returncode != 0:
+            print(restart.stderr[-3000:], file=sys.stderr)
+            raise SystemExit("stream restart child failed rc="
+                             f"{restart.returncode}")
+        line = [ln for ln in restart.stdout.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        rst = json.loads(line[len("RESULT "):])
+        # fleet-wide accounting off the shared journal: every index
+        # resolved exactly once across victim + restart
+        seen: dict = {}
+        nonfinite_drill = 0
+        with open(journal) as f:
+            for ln in f:
+                try:
+                    d = json.loads(ln)
+                    i, status = int(d["i"]), d["status"]
+                except (ValueError, KeyError, TypeError):
+                    # the victim's SIGKILL can tear its final line;
+                    # the fragment's index was never durably recorded
+                    # and the restart child replayed it
+                    continue
+                seen[i] = status
+                if status == "nonfinite":
+                    nonfinite_drill += 1
+        lost = p["requests"] - len(seen)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    drill = {
+        "chaos_spec": spec,
+        "killed_rc": victim.returncode,
+        "killed_by_sigkill": killed_by_sigkill,
+        "victim_journaled": victim_done,
+        "restart": rst,
+        "lost": lost,
+        "hung": rst["unresolved"],
+        "nonfinite": nonfinite_drill,
+        "by_status": _count_statuses(seen),
+    }
+    gate = {
+        "overlap": (overlap_ratio is not None
+                    and overlap_ratio <= p["tol"]),
+        "swaps": swaps_total >= 1,
+        "zero_unresolved": unresolved == 0,
+        "zero_nonfinite": nonfinite == 0 and nonfinite_drill == 0,
+        "all_typed": (untyped == 0
+                      and sum(1 for s in seen.values()
+                              if s == "error") == 0),
+        # every drill request resolved OK fleet-wide — zero_lost/
+        # zero_hung alone would pass a journaled typed FAILURE
+        # (stale_rejected, serve_error) as accounted-for
+        "drill_all_ok": (len(seen) > 0
+                         and all(s == "ok" for s in seen.values())),
+        "berr_guard_never_breached": breaches == 0 and rejected == 0
+        and rst["guard_breaches"] == 0,
+        "kill_mid_swap": killed_by_sigkill,
+        "zero_lost": lost == 0,
+        "zero_hung": rst["unresolved"] == 0,
+        "warm_generation_restart": (rst["factorizations_at_prime"]
+                                    == 0 and rst["store_hits"] >= 1
+                                    and rst["prime_step"] >= 1),
+    }
+    gate["passed"] = all(gate.values())
+    rec = {
+        "mode": "stream",
+        "desc": f"streaming refactorization drift drill 3D Laplacian "
+                f"n={a.n}",
+        "n": a.n, "k": p["k"], "requests": p["requests"],
+        "steps": p["steps"], "step_hz": p["step_hz"],
+        "drift": p["drift"], "concurrency": p["concurrency"],
+        "trials": p["trials"],
+        "arms": {
+            arm: {
+                "p99_ms": [round(r.get("p99_ms", 0.0), 3)
+                           for r in reps],
+                "solves_per_s": [round(r["solves_per_s"], 2)
+                                 for r in reps],
+                "by_status": _merge_statuses(r["by_status"]
+                                             for r in reps),
+                "swaps": sum(r["stream"]["swaps"] for r in reps),
+                # per-run deltas (run_stream_load) summed over the
+                # arm's trials: each arm's figure is ITS solves only
+                "stale_solves": sum(r["stream"]["stale_solves"]
+                                    for r in reps),
+                "fresh_solves": sum(r["stream"]["fresh_solves"]
+                                    for r in reps),
+            } for arm, reps in arms.items()
+        },
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "overlap_ratio": (round(overlap_ratio, 4)
+                          if overlap_ratio is not None else None),
+        "overlap_tol": p["tol"],
+        "swaps": swaps_total,
+        "guard_breaches": breaches,
+        "stale_rejected": rejected,
+        "unresolved": unresolved,
+        "lost": lost,
+        "hung": rst["unresolved"],
+        "drill": drill,
+        "gate": gate,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    if not gate["passed"]:
+        rec["measurement_invalid"] = True
+        print(json.dumps(rec))
+        print(f"# STREAM GATE FAILED: "
+              f"{ {k: v for k, v in gate.items() if not v} }",
+              file=sys.stderr)
+        raise SystemExit(1)
+    line = json.dumps(rec)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    return rec
+
+
+def _count_statuses(seen: dict) -> dict:
+    out: dict = {}
+    for s in seen.values():
+        out[s] = out.get(s, 0) + 1
+    return out
+
+
+def _merge_statuses(dicts) -> dict:
+    out: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
 def _regress_gate(repo):
     """Post-run perf-regression sentinel: the record just appended is
     now the latest — gate it against the committed baselines."""
@@ -708,6 +1164,20 @@ def main():
     if "--cold-boot-child" in argv:
         i = argv.index("--cold-boot-child")
         run_cold_boot_child(int(argv[i + 1]), int(argv[i + 2]))
+        return
+    for kind, fn in (("--stream-child", run_stream_child),
+                     ("--stream-restart-child",
+                      run_stream_restart_child)):
+        if kind in argv:
+            i = argv.index(kind)
+            fn(int(argv[i + 1]), int(argv[i + 2]), int(argv[i + 3]),
+               float(argv[i + 4]), int(argv[i + 5]), argv[i + 6])
+            return
+    if "--stream" in argv:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        run_stream(argv)
+        _regress_gate(repo)
         return
     if "--cold-boot" in argv:
         repo = os.path.dirname(os.path.dirname(

@@ -45,7 +45,8 @@ RULE_JOIN_LOCK = "join-under-lock"
 AUDIT_PREFIXES = ("superlu_dist_tpu/serve/",
                   "superlu_dist_tpu/resilience/",
                   "superlu_dist_tpu/obs/",
-                  "superlu_dist_tpu/fleet/")
+                  "superlu_dist_tpu/fleet/",
+                  "superlu_dist_tpu/stream/")
 AUDIT_FILES = ("superlu_dist_tpu/utils/warmup.py",)
 
 

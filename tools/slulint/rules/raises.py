@@ -36,7 +36,8 @@ _PRECONDITION = {"ValueError", "TypeError", "KeyError", "IndexError",
 
 def _serve_scope(path: str) -> bool:
     parts = path.split("/")
-    return "serve" in parts or "resilience" in parts
+    return ("serve" in parts or "resilience" in parts
+            or "stream" in parts)
 
 
 def check(tree, src, path, ann):
