@@ -954,6 +954,68 @@ def _factor_ab():
             f.write(json.dumps(rec) + "\n")
 
 
+def _gauntlet():
+    """Hard-matrix gauntlet drill (ISSUE 15): run the numerics/
+    corpus (kappa ladder to 1/eps, structural/numeric singularity,
+    wild scaling, NaN/Inf poisoning, malformed shapes) through the
+    one-call driver with the condition policy ON, and gate on ZERO
+    silent-wrong answers and ZERO untyped failures.  Per-case lines +
+    one mode="gauntlet" summary append to SLU_GAUNTLET_OUT
+    (GAUNTLET.jsonl, regress-gated by tools/regress.py).  A failed
+    gate stamps every line measurement_invalid, persists NOTHING, and
+    exits 1 — the --factor-ab discipline."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    # the drill runs with the whole defense in force: eager rcond
+    # estimation + the (default) stamp policy.  An operator override
+    # in the ambient env is respected — refuse mode must also gate.
+    os.environ.setdefault("SLU_COND_ESTIMATE", "1")
+    import jax
+    dev = jax.devices()[0]
+
+    from superlu_dist_tpu.numerics.gauntlet import run_gauntlet
+    print("# gauntlet: running the hard-matrix corpus ...",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    records, summary = run_gauntlet()
+    wall = time.perf_counter() - t0
+
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+    lines = []
+    for r in records:
+        rec = dict(r)
+        rec.update(mode="gauntlet_case", platform=dev.platform,
+                   ts=ts)
+        lines.append(rec)
+    lines.append(dict(
+        mode="gauntlet", platform=dev.platform,
+        device_kind=getattr(dev, "device_kind", ""),
+        cases=summary["cases"], counts=summary["counts"],
+        gate=summary["gate"], wall_s=round(wall, 3),
+        cond_policy=os.environ.get("SLU_COND_POLICY", "stamp"),
+        ts=ts))
+    ok = summary["gate"]["passed"]
+    for rec in lines:
+        if not ok:
+            rec["measurement_invalid"] = True
+        print(json.dumps(rec))
+    if not ok:
+        print(f"# GAUNTLET GATE FAILURE (silent_wrong="
+              f"{summary['gate']['silent_wrong']} untyped="
+              f"{summary['gate']['untyped']}); records not persisted",
+              file=sys.stderr)
+        raise SystemExit(1)
+    out_path = os.environ.get(
+        "SLU_GAUNTLET_OUT", os.path.join(repo, "GAUNTLET.jsonl"))
+    with open(out_path, "a") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+
+
 def main():
     # --trace PATH: export the run's phase spans + compile events as
     # a Chrome trace-event JSON (Perfetto-loadable) alongside the
@@ -1019,6 +1081,12 @@ def main():
         # legacy level sweep vs merged lsum trisolve, records with an
         # `arm` field appended to SOLVE_LATENCY.jsonl
         _solve_sweep()
+        return
+    if "--gauntlet" in sys.argv[1:]:
+        # hard-matrix gauntlet (ISSUE 15): numerical defense drill,
+        # gate = zero silent-wrong answers + zero untyped failures;
+        # appends to GAUNTLET.jsonl, gated by tools/regress.py
+        _gauntlet()
         return
     if "--factor-ab" in sys.argv[1:]:
         # staged factor-sweep A/B (ISSUE 12): per-group vs

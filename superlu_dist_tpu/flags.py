@@ -82,9 +82,16 @@ FLAGS: dict[str, str] = {
     "SLU_PREC_LADDER": "comma dtype list overriding the escalation ladder (default bfloat16,float32,float64; sorted by eps, climbed one rung per failed refinement contract — each rung re-pays one factorization)",
     "SLU_PREC_TIERS": "1 = serve-layer dtype-TIER serving: a cold high-precision request rides resident lower-rung factors via df64 refinement (saves a cold factorization; costs ~2-3 extra refinement sweeps per solve, berr-guarded with automatic re-key on miss)",
     "SLU_PREC_AB_OUT": "bench.py --prec output path (default PREC_AB.jsonl)",
+    # --- numerical trust layer (numerics/, models/gssvx.py, serve/) ---
+    "SLU_COND_ESTIMATE": "1 = eager Hager-Higham rcond estimation after every driver/serve factorization (numerics/gscon.py): at most 2*SLU_COND_MAXITER+2 refinement-free packed-trisolve solves per factorization, ZERO extra factorizations; off (default) = rcond stays lazy via ensure_rcond and the condition policy never engages",
+    "SLU_COND_MAXITER": "Hager-Higham iteration cap per rcond estimate (default 5; each iteration is one forward + one transpose solve)",
+    "SLU_COND_FLOOR": "rcond refusal floor: an estimated rcond at or below this raises typed SingularMatrixError instead of serving a garbage solve (default 0 = auto: eps(refine_dtype)); only engaged when an estimate exists",
+    "SLU_COND_POLICY": "serve|stamp|refuse condition-aware serving policy for ill-conditioned (above-floor) keys: serve = silent, stamp (default) = results ride a PerturbedResult/ill-conditioned label, refuse = typed SingularMatrixError; floor refusal applies in every mode",
+    "SLU_COND_STAMP": "ill-conditioned classification threshold on rcond (default 0 = auto: sqrt(eps(refine_dtype))); below it the policy mode engages, the serve berr guard tightens by SLU_COND_SLACK_DIV, and the escalation ladder climbs a rung before first serve",
+    "SLU_COND_SLACK_DIV": "divisor applied to the 64-eps berr guard slack for keys classified ill-conditioned (default 8: guard tightens to 8*eps) — high-kappa keys get less refinement slack, not more",
     # --- resilience (resilience/, serve/factor_cache.py) ---
     "SLU_FT_STORE": "durable factor-store directory: FactorCache write-through/read-through persistence tier (atomic rename + sha256 framing + per-array ABFT checksum; corrupt entries quarantined to *.quarantined, never served; a restarted replica boots warm)",
-    "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds), store_latency, lease_steal, replica_kill, refactor_raise, refactor_slow, swap_kill (the stream pipeline's background-failure + mid-swap-crash sites); deterministic per-site seeded streams; every site is one pointer check when unset",
+    "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds), store_latency, lease_steal, replica_kill, refactor_raise, refactor_slow, swap_kill (the stream pipeline's background-failure + mid-swap-crash sites), near_singular (param = skew strength: deterministic value-skew of incoming stream values toward rank deficiency, the rcond-drift drill's fault); deterministic per-site seeded streams; every site is one pointer check when unset",
     "SLU_CHAOS_SEED": "chaos RNG seed (default 0): same spec+seed replays the identical failure sequence",
     "SLU_CHAOS_OUT": "serve_bench --chaos record path (default CHAOS.jsonl)",
     # --- fleet coordination (fleet/, serve/, tools/fleet_drill.py) ---
@@ -109,6 +116,7 @@ FLAGS: dict[str, str] = {
     "SLU_STREAM_DRIFT": "serve_bench --stream per-step relative value drift amplitude (default 5e-4: calibrated so a full 24-step walk refines ~2 decades inside the berr guard off the pinned generation-1 factors; 2e-3 breaches by step ~8)",
     "SLU_STREAM_TRIALS": "serve_bench --stream interleaved overlap A/B pair count (default 3; the measurement is the p99 ratio over each arm's POOLED ok latencies across all trials — per-pair ratios ride the worst ~2 samples of each run and flip on scheduler noise; they stay in the record as pair_ratios)",
     "SLU_STREAM_OVERLAP_TOL": "serve_bench --stream gate ceiling on steady-state p99 of the background-refactor arm over the pinned (no-refactor) arm (default 1.10 — the ISSUE-13 overlap acceptance); a failed gate stamps measurement_invalid and persists nothing",
+    "SLU_STREAM_RCOND_DRIFT": "stream cadence rcond-drift trigger ratio (default 100): a background refactorization is requested when the latest generation's estimated rcond fell below baseline/ratio — conditioning decay caught alongside the berr trajectory; inert unless rcond estimates flow (SLU_COND_ESTIMATE)",
     # --- native library (utils/native.py) ---
     "SLU_TPU_NO_NATIVE": "1 = never build/load the native helper .so (pure-python fallbacks)",
     # --- accelerator amalgamation defaults (utils/platform.py) ---
@@ -133,6 +141,7 @@ FLAGS: dict[str, str] = {
     "SLU_BENCH_SWEEP_KS": "comma list of k values for the sweep",
     "SLU_BENCH_SWEEP_PATH": "output path for sweep records (default BENCH_SWEEP.jsonl)",
     "SLU_SWEEP_CONFIG_TIMEOUT": "per-config subprocess budget in the sweep (s)",
+    "SLU_GAUNTLET_OUT": "bench.py --gauntlet record path (default GAUNTLET.jsonl): the hard-matrix corpus drill appends one per-case line per entry plus one mode=gauntlet summary record, regress-gated on zero silent-wrong answers; a failed gate stamps measurement_invalid and persists nothing",
     # --- tools/ drivers ---
     "SLU_SCALE_K": "tools/scale_run.py grid size (k=64 is the 262k certification)",
     "SLU_SCALE_OUT": "tools/scale_run.py output json path",

@@ -56,6 +56,12 @@ class LUFactorization:
     # other
     cache_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False)
+    # numerical-trust fields (numerics/): the Hager-Higham rcond
+    # estimate (None until numerics.gscon.ensure_rcond caches it —
+    # replace copies carry a computed value forward) and the
+    # tiny-pivot perturbation ledger factorize() stamps
+    rcond: Optional[float] = None
+    ledger: Optional[object] = None   # numerics.ledger.PerturbationLedger
 
     @property
     def n(self) -> int:
@@ -178,12 +184,25 @@ def factorize(a: CSRMatrix, options: Options | None = None,
     # numerical-health watch (obs/health.py): GESP never pivots at
     # runtime, so every factorization reports its tiny-pivot
     # replacements — and, when tracing is on (the estimate walks
-    # diag(U) to the host), a pivot-growth estimate
+    # diag(U) to the host), a pivot-growth estimate.  The perturbation
+    # ledger (numerics/ledger.py) makes the replacements first-class:
+    # count, original-column locations and injected magnitude ride
+    # the handle, the health ring and (via the serve layer) flight
+    # records and result stamps.  Free on a clean factorization — the
+    # O(n) diagonal gather only runs when the device counter is
+    # nonzero.
+    from ..numerics.ledger import build_ledger
     src = lu.host_lu if lu.backend == "host" else lu.device_lu
+    lu.ledger = build_ledger(lu)
     obs.HEALTH.record_factor(
         tiny_pivots=int(getattr(src, "tiny_pivots", 0)),
         pivot_growth=(obs.pivot_growth(lu) if obs.enabled() else None),
-        dtype=options.factor_dtype)
+        dtype=options.factor_dtype,
+        perturbation=(lu.ledger.to_dict() if lu.ledger.perturbed
+                      else None))
+    stats.note_factor_event(tiny_pivots=int(getattr(src, "tiny_pivots",
+                                                    0)),
+                            dtype=options.factor_dtype)
     return lu
 
 
@@ -469,6 +488,12 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
     ColPerm.MY_PERMC."""
     options = options or Options()
     stats = stats if stats is not None else Stats()
+    # front-door validation (numerics/): a poisoned or malformed
+    # system is refused with a typed error BEFORE a factorization
+    # burns — until this gate only factor OUTPUT had a finite check
+    # (factors_finite), so NaN inputs cost a full factorization to
+    # detect.  O(nnz + n·nrhs) host scans, once per driver call.
+    _validate_system(a, b)
     # this run's phase stats become the registry's "stats" surface
     # (last-solve-wins — the PStatPrint cardinality); the root span
     # makes every numeric-phase span a CHILD in the exported trace
@@ -477,6 +502,88 @@ def gssvx(options: Options | None, a: CSRMatrix, b: np.ndarray,
                   args={"n": a.n, "fact": options.fact.name}):
         return _gssvx_impl(options, a, b, stats, backend, lu,
                            user_perm_r, user_perm_c, grid)
+
+
+def _validate_system(a, b) -> None:
+    """Typed front-door rejection of malformed systems (numerics/
+    errors.InvalidInputError — a ValueError, so pre-existing callers
+    catching ValueError keep working)."""
+    from ..numerics.errors import InvalidInputError
+    n = int(getattr(a, "n", 0))
+    if n == 0:
+        raise InvalidInputError("empty system: A is 0x0")
+    b = np.asarray(b)
+    if b.ndim not in (1, 2) or b.shape[0] != n:
+        raise InvalidInputError(
+            f"b has shape {b.shape} but the matrix is {n}x{n}")
+    if b.size == 0:
+        raise InvalidInputError("empty right-hand side: b has 0 "
+                                "columns")
+    vals = getattr(a, "data", None)
+    if vals is not None and not bool(np.isfinite(vals).all()):
+        raise InvalidInputError(
+            "non-finite entries in A: a NaN/Inf value would poison "
+            "the factors (GESP has no runtime pivoting to catch it); "
+            "refused before paying a factorization")
+    if not bool(np.isfinite(b).all()):
+        raise InvalidInputError("non-finite entries in b")
+
+
+def _condition_gate(options, a, lu, stats, backend, grid):
+    """Eager condition estimation + policy enforcement after a
+    factorization (SLU_COND_ESTIMATE=1): estimate rcond off the
+    resident factors, refuse numerically singular systems with typed
+    SingularMatrixError, and climb the precision ladder one rung
+    BEFORE the first serve when the key classifies ill-conditioned —
+    precision buys back digits exactly when kappa eats them, and
+    paying the rung up-front beats discovering it via a stalled
+    refinement later.  Terminates at the ladder ceiling like the berr
+    ladder below."""
+    from ..numerics.gscon import ensure_rcond
+    from ..numerics.policy import ConditionPolicy, cond_estimate_enabled
+    if not cond_estimate_enabled():
+        return lu
+    from ..precision.policy import next_factor_dtype
+    policy = ConditionPolicy.from_env()
+    while True:
+        rcond = ensure_rcond(lu)
+        stats.rcond = rcond
+        cls = policy.enforce(rcond, options.refine_dtype)
+        if (cls != "ill" or options.fact == Fact.FACTORED
+                or not options.escalate):
+            return lu
+        cur = lu.effective_options.factor_dtype
+        nxt = next_factor_dtype(cur, ceiling=options.refine_dtype)
+        if nxt is None:
+            return lu
+        stats.escalations += 1
+        obs.HEALTH.record_escalation(
+            berr=stats.berr, factor_dtype=cur,
+            refine_dtype=options.refine_dtype,
+            to_dtype=nxt, trigger="ill_conditioned")
+        lu = factorize(a, options.replace(factor_dtype=nxt),
+                       plan=lu.plan, stats=stats, backend=backend,
+                       grid=grid, _phase="FACT_ESC")
+
+
+def _stamp_result(x, lu, options):
+    """Label solutions that rode perturbed or ill-conditioned factors
+    (numerics/ledger.PerturbedResult): zero-copy view stamp, applied
+    only on the rare dishonest-to-hide paths — a clean
+    well-conditioned solve returns a plain ndarray."""
+    led = getattr(lu, "ledger", None)
+    rcond = getattr(lu, "rcond", None)
+    ill = False
+    if rcond is not None:
+        from ..numerics.policy import ConditionPolicy
+        policy = ConditionPolicy.from_env()
+        ill = (policy.mode == "stamp"
+               and policy.classify(rcond,
+                                   options.refine_dtype) == "ill")
+    if (led is not None and led.perturbed) or ill:
+        from ..numerics.ledger import stamp_perturbed
+        return stamp_perturbed(x, ledger=led, rcond=rcond)
+    return x
 
 
 def _gssvx_impl(options, a, b, stats, backend, lu,
@@ -530,6 +637,10 @@ def _gssvx_impl(options, a, b, stats, backend, lu,
         lu = factorize(a, options, stats=stats, backend=backend,
                        user_perm_r=user_perm_r, user_perm_c=user_perm_c,
                        grid=grid)
+    # condition gate BEFORE the first solve: refuse numerically
+    # singular factors (typed, never a garbage solve) and pre-climb
+    # the ladder for ill-conditioned keys under SLU_COND_ESTIMATE=1
+    lu = _condition_gate(options, a, lu, stats, backend, grid)
     x = solve(lu, b, stats=stats)
     # Precision-escalation LADDER (precision/policy.py): when a
     # low-precision factor fails its refinement contract
@@ -567,7 +678,14 @@ def _gssvx_impl(options, a, b, stats, backend, lu,
         lu = factorize(a, opts2, plan=lu.plan, stats=stats,
                        backend=backend, grid=grid, _phase="FACT_ESC")
         x = solve(lu, b, stats=stats)
-    return x, lu, stats
+    # re-gate after any berr-driven escalation: the rcond of the
+    # ESCALATED handle is the one the policy (and the stamp) must
+    # describe; free when no escalation ran (rcond already cached)
+    lu2 = _condition_gate(options, a, lu, stats, backend, grid)
+    if lu2 is not lu:
+        lu = lu2
+        x = solve(lu, b, stats=stats)
+    return _stamp_result(x, lu, options), lu, stats
 
 
 def _escalation_trigger(options: Options, lu: LUFactorization,

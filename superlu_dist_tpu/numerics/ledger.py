@@ -1,0 +1,125 @@
+"""Perturbation ledger: tiny-pivot replacements as first-class data.
+
+GESP replaces a pivot whose magnitude falls below sqrt(eps)·‖A‖ with
+sign(piv)·sqrt(eps)·‖A‖ (ops/batched.py `_thresh_for`,
+SRC/pdgstrf2.c's rule) and, until this module, recorded only a
+lifetime COUNT.  The ledger makes each factorization's perturbation
+auditable: how many pivots, WHERE (original column indices), and the
+total magnitude injected — the data a caller needs to decide whether
+a solve through these factors is trustworthy, and the payload the
+serve layer stamps onto results (serve/errors.PerturbedResult) and
+flight records.
+
+Location recovery is post-hoc and free on the happy path: replaced
+pivots sit at EXACTLY ±thresh in diag(U), so when the device counter
+says count > 0 one O(n) diagonal gather (models/gssvx.get_diag_u —
+only n scalars cross to the host) identifies them; a clean
+factorization (count == 0) never pays the gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# stamped payloads ride flight records and health rings; cap the
+# per-factorization location list so a pathological matrix (every
+# pivot tiny) cannot bloat every downstream record
+_MAX_LOCATIONS = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class PerturbationLedger:
+    """One factorization's tiny-pivot replacement record."""
+    count: int                       # pivots replaced
+    threshold: float                 # replacement magnitude sqrt(eps)*anorm
+    locations: tuple = ()            # original column indices (capped)
+    truncated: bool = False          # locations hit _MAX_LOCATIONS
+    total_magnitude: float = 0.0     # sum |new pivot| over replacements
+
+    @property
+    def perturbed(self) -> bool:
+        return self.count > 0
+
+    def to_dict(self) -> dict:
+        return {"count": int(self.count),
+                "threshold": float(self.threshold),
+                "locations": [int(i) for i in self.locations],
+                "truncated": bool(self.truncated),
+                "total_magnitude": float(self.total_magnitude)}
+
+
+class PerturbedResult(np.ndarray):
+    """Marker subclass stamped on solutions that rode PERTURBED or
+    ill-conditioned factors: GESP replaced tiny pivots during the
+    factorization (the `ledger` attribute carries this module's
+    record) and/or the estimated rcond classified the key
+    ill-conditioned under SLU_COND_POLICY=stamp (`rcond` attribute).
+    Like serve/errors.DegradedResult (which re-exports this class):
+    numerically a normal ndarray behind the (tightened) berr guard —
+    the stamp is the honesty, not a different number; `np.asarray(x)`
+    strips it."""
+
+    ledger = None       # PerturbationLedger | None
+    rcond = None        # float | None
+
+    def __array_finalize__(self, obj):
+        # slices/views inherit the stamp payload — the micro-batcher
+        # splits one batched solve into per-request columns, and each
+        # column must carry the ledger it rode
+        if obj is None:
+            return
+        self.ledger = getattr(obj, "ledger", None)
+        self.rcond = getattr(obj, "rcond", None)
+
+
+def stamp_perturbed(x: np.ndarray, ledger=None,
+                    rcond=None) -> PerturbedResult:
+    """View-stamp a solution as perturbed/ill-conditioned (zero-copy;
+    the ndarray-subclass pattern serve/_mark_degraded uses)."""
+    out = np.asarray(x).view(PerturbedResult)
+    out.ledger = ledger
+    out.rcond = rcond
+    return out
+
+
+def build_ledger(lu) -> PerturbationLedger:
+    """Ledger for a live factorization handle.  Reads the device
+    tiny-pivot counter the factor kernels accumulated; only when it is
+    nonzero does the O(n) diagonal gather run to recover locations."""
+    from ..models.gssvx import get_diag_u
+    from ..ops.batched import _thresh_for
+    src = lu.host_lu if lu.backend == "host" else lu.device_lu
+    count = int(getattr(src, "tiny_pivots", 0))
+    fdt = np.dtype(lu.effective_options.factor_dtype)
+    thresh = float(_thresh_for(lu.plan, fdt))
+    if count == 0 or thresh == 0.0:
+        return PerturbationLedger(count=count, threshold=thresh)
+    try:
+        diag = get_diag_u(lu)
+    except (ValueError, NotImplementedError):
+        # mesh-bound factors with no addressable diagonal: the count
+        # stands, the locations stay unknown
+        return PerturbationLedger(count=count, threshold=thresh,
+                                  total_magnitude=count * thresh)
+    # replaced pivots are EXACTLY ±thresh in the factor dtype; compare
+    # against thresh rounded through that dtype with a few ulps of
+    # slack (bfloat16 factors round the threshold itself)
+    rdt = np.dtype(fdt.char.lower()) if fdt.kind == "c" else fdt
+    # jnp.finfo, not np.finfo: the factor dtype may be an ml_dtypes
+    # family member (bfloat16) numpy's finfo rejects
+    import jax.numpy as jnp
+    tol = 16.0 * float(jnp.finfo(rdt).eps)
+    t_cast = float(np.abs(np.asarray(thresh, dtype=rdt)))
+    mag = np.abs(np.asarray(diag, dtype=np.complex128
+                            if fdt.kind == "c" else np.float64))
+    # diag is in factor column order; diag[final_col[j]] is original
+    # column j's pivot — reindex so locations are caller-meaningful
+    hit = np.flatnonzero(np.abs(mag[lu.plan.final_col] - t_cast)
+                         <= tol * max(t_cast, 1.0))
+    locs = tuple(int(i) for i in hit[:_MAX_LOCATIONS])
+    return PerturbationLedger(
+        count=count, threshold=thresh, locations=locs,
+        truncated=len(hit) > _MAX_LOCATIONS,
+        total_magnitude=count * thresh)

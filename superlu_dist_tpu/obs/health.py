@@ -49,21 +49,66 @@ class HealthMonitor:
         # of {from_dtype, to_dtype, trigger, berr} events
         self.escalations_by_trigger: dict = {}
         self._esc_recent = collections.deque(maxlen=recent_cap)
+        # numerical-trust layer (numerics/, ISSUE 15): per-
+        # factorization perturbation ledgers + rcond estimates
+        self.perturbed_factorizations = 0
+        self.pivot_growth_unavailable = 0   # probe couldn't run
+        self.last_rcond: float | None = None
+        self.rcond_estimates = 0
+        self._factor_recent = collections.deque(maxlen=recent_cap)
 
     # -- recording hooks ----------------------------------------------
 
     def record_factor(self, *, tiny_pivots: int = 0,
                       pivot_growth: float | None = None,
-                      dtype: str = "") -> None:
+                      dtype: str = "",
+                      perturbation: dict | None = None) -> None:
+        """One factorization's numerical outcome.  `perturbation` is
+        the tiny-pivot ledger dict (numerics/ledger.to_dict()) when
+        GESP replaced any pivots; it rides the per-factorization ring
+        so snapshot() exposes WHERE and how much, not just a lifetime
+        count."""
         with self._lock:
             self.factorizations += 1
             self.tiny_pivots_total += int(tiny_pivots)
             if pivot_growth is not None:
                 self.last_pivot_growth = float(pivot_growth)
+            if perturbation is not None:
+                self.perturbed_factorizations += 1
+            self._factor_recent.append({
+                "tiny_pivots": int(tiny_pivots),
+                "dtype": dtype,
+                "pivot_growth": (float(pivot_growth)
+                                 if pivot_growth is not None else None),
+                "perturbation": (dict(perturbation)
+                                 if perturbation is not None else None),
+            })
         if tiny_pivots:
             _tracer.instant("health.tiny_pivots", cat="health",
                             args={"count": int(tiny_pivots),
                                   "dtype": dtype})
+
+    def record_pivot_growth_unavailable(self, *,
+                                        dtype: str = "") -> None:
+        """The pivot-growth probe could not run (mesh-bound factors
+        with no addressable diagonal, or a transfer failure).  Until
+        ISSUE 15 this was a SILENT None — the monitor showed the
+        previous factorization's growth figure as if it were current.
+        Now it is a counted health event."""
+        with self._lock:
+            self.pivot_growth_unavailable += 1
+        _tracer.instant("health.pivot_growth_unavailable",
+                        cat="health", args={"dtype": dtype})
+
+    def record_rcond(self, rcond: float | None) -> None:
+        """One Hager-Higham condition estimate (numerics/gscon.py)."""
+        if rcond is None:
+            return
+        with self._lock:
+            self.rcond_estimates += 1
+            self.last_rcond = float(rcond)
+        _tracer.instant("health.rcond", cat="health",
+                        args={"rcond": float(rcond)})
 
     def record_refine(self, *, berr: float, steps: int,
                       berr_trajectory=(), ferr_trajectory=(),
@@ -138,6 +183,16 @@ class HealthMonitor:
                 "last_berr": self.last_berr,
                 "last_pivot_growth": self.last_pivot_growth,
                 "last_solve": dict(last) if last else None,
+                "perturbed_factorizations":
+                    self.perturbed_factorizations,
+                "pivot_growth_unavailable":
+                    self.pivot_growth_unavailable,
+                "last_rcond": self.last_rcond,
+                "rcond_estimates": self.rcond_estimates,
+                "factor_events":
+                    [dict(e) for e in self._factor_recent],
+                "last_factor": (dict(self._factor_recent[-1])
+                                if self._factor_recent else None),
                 # {trigger: count} flattens into dump_text lines
                 # (slu_health_escalations_by_trigger_<t>); the event
                 # ring is the structured view
@@ -158,6 +213,11 @@ class HealthMonitor:
                  f"stalled refines {self.stalled_refines}")
             if self.last_pivot_growth:
                 s += f", pivot growth {self.last_pivot_growth:.2e}"
+            if self.pivot_growth_unavailable:
+                s += (", pivot growth unavailable "
+                      f"{self.pivot_growth_unavailable}x")
+            if self.last_rcond is not None:
+                s += f", rcond {self.last_rcond:.2e}"
             return s
 
 
@@ -169,13 +229,20 @@ def pivot_growth(lu) -> float | None:
     bound; compare against 1/eps of the factor dtype.  Returns None
     instead of raising when the factors can't be probed (e.g. a
     mesh-sharded U spanning non-addressable devices) — this runs on
-    the factorize path, and observability never throws into it."""
+    the factorize path, and observability never throws into it.  The
+    None is no longer SILENT: it is counted as a
+    `pivot_growth_unavailable` health event, so a monitor showing a
+    stale last_pivot_growth figure is distinguishable from one whose
+    probe is actually running."""
     try:
         from ..models.gssvx import get_diag_u
         du = np.abs(np.asarray(get_diag_u(lu)))
         anorm = float(getattr(lu.plan, "anorm", 0.0)) or 1.0
         return float(du.max() / anorm) if du.size else 0.0
     except Exception:
+        HEALTH.record_pivot_growth_unavailable(
+            dtype=str(getattr(getattr(lu, "effective_options", None),
+                              "factor_dtype", "")))
         return None
 
 

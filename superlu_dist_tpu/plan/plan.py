@@ -88,6 +88,35 @@ class FactorPlan:
                 * self.col_scale[self.coo_cols])
 
 
+def _check_structure(a: CSRMatrix, coo_rows, coo_cols) -> None:
+    """Raise typed StructurallySingularError for rows/columns with no
+    STORED entry.  Pattern-based on purpose: an explicitly stored
+    zero keeps the row structurally alive (the reference's semantics
+    — an exact-zero pivot with replacement off is a FACTOR-time
+    ZeroDivisionError, not a plan-time refusal), while a pattern-empty
+    row admits no LU under any values.  numerics/errors.py imports
+    nothing back from the package, so plan/ can raise it cycle-free."""
+    from ..numerics.errors import StructurallySingularError
+    row_hit = np.zeros(a.m, dtype=bool)
+    row_hit[coo_rows] = True
+    col_hit = np.zeros(a.n, dtype=bool)
+    col_hit[coo_cols] = True
+    if row_hit.all() and col_hit.all():
+        return
+    empty_rows = tuple(int(i) for i in np.flatnonzero(~row_hit)[:32])
+    empty_cols = tuple(int(i) for i in np.flatnonzero(~col_hit)[:32])
+    what = []
+    if empty_rows:
+        what.append(f"empty rows {list(empty_rows)}")
+    if empty_cols:
+        what.append(f"empty columns {list(empty_cols)}")
+    raise StructurallySingularError(
+        "matrix is structurally singular: " + ", ".join(what)
+        + " (no stored entries) — no pivoting strategy can factor "
+        "it; refused at plan time before any numeric work",
+        empty_rows=empty_rows, empty_cols=empty_cols)
+
+
 def plan_factorization(a: CSRMatrix, options: Options | None = None,
                        stats: Stats | None = None,
                        user_perm_r: np.ndarray | None = None,
@@ -107,6 +136,14 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
     n = a.n
 
     coo_rows, coo_cols, _ = a.to_coo()
+
+    # structural-singularity gate (numerics/): a row or column with no
+    # (nonzero) entries is singular BEFORE any arithmetic — detectable
+    # here for the cost of two bincounts, and a typed error beats the
+    # equilibration ValueError (which only fired with options.equil on;
+    # with it off the defect used to slip through to the factor kernels
+    # and come back as tiny-pivot garbage)
+    _check_structure(a, coo_rows, coo_cols)
 
     # [Equil] (pdgssvx.c:718,736)
     with stats.timer("EQUIL"):

@@ -40,6 +40,13 @@ Spec grammar (`SLU_CHAOS` or `install(spec)`):
                                   in-memory assignment (DRILL-ONLY:
                                   the mid-swap crash the warm-restart
                                   gate proves safe)
+        near_singular=1:0.5       skew incoming STREAM value sets
+                                  toward rank deficiency (param =
+                                  skew strength s in [0,1): values
+                                  blend (1-s)·v + s·mean(v), exactly
+                                  singular at s=1) — the drift fault
+                                  the rcond-drift cadence trigger and
+                                  the condition policy must catch
 
 Determinism: each site owns a `random.Random` seeded from
 (`SLU_CHAOS_SEED`, site name), so the same spec+seed replays the same
@@ -62,7 +69,8 @@ from .. import flags
 
 SITES = ("factor_raise", "factor_nan", "store_flip", "flusher_raise",
          "latency", "store_latency", "lease_steal", "replica_kill",
-         "refactor_raise", "refactor_slow", "swap_kill")
+         "refactor_raise", "refactor_slow", "swap_kill",
+         "near_singular")
 
 
 def _stable_seed(seed: int, *legs) -> int:
@@ -229,6 +237,27 @@ def maybe_sigkill(site: str = "swap_kill") -> None:
     import os
     import signal
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_skew_singular(site: str, a):
+    """Deterministically skew a value set toward rank deficiency when
+    `site` fires: v' = (1-s)·v + s·mean(v) blends every stored entry
+    toward the constant vector (a rank-1 value pattern — exactly
+    singular at s=1), with s = the site's param (default 0.5).  The
+    PATTERN is untouched, so the skewed matrix stays in the same
+    stream.  Returns the input object unchanged when the site does not
+    fire (one pointer check when chaos is off), else a NEW matrix of
+    the same type — callers must rekey off the return value."""
+    if not should(site):
+        return a
+    import dataclasses as _dc
+
+    import numpy as np
+    p = _POLICY
+    s = min(max(p.param(site, 0.5), 0.0), 1.0)
+    v = np.asarray(a.data)
+    skewed = (1.0 - s) * v + s * v.mean()
+    return _dc.replace(a, data=skewed.astype(v.dtype))
 
 
 def maybe_poison_factors(site: str, lu) -> None:

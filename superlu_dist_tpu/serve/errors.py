@@ -18,6 +18,17 @@ import os
 
 import numpy as np
 
+# the numerical-trust taxonomy (numerics/errors.py) re-exported here
+# so service callers import ONE failure vocabulary; numerics/ sits
+# below serve/ and imports nothing back, so this is cycle-free
+from ..numerics.errors import (  # noqa: F401 — re-exports
+    InvalidInputError,
+    NumericalError,
+    SingularMatrixError,
+    StructurallySingularError,
+)
+from ..numerics.ledger import PerturbedResult  # noqa: F401 — re-export
+
 
 class ServeError(RuntimeError):
     """Base class for service-level request failures."""
@@ -79,7 +90,6 @@ class DegradedResult(np.ndarray):
     DegradedResult)` is the stamp; `np.asarray(x)` strips it) — the
     honest alternative to an outage, never a silent substitute for a
     healthy solve."""
-
 
 def _record_factor_arm(rec: dict) -> str | None:
     """The factor arm a t_factor_s record was measured under

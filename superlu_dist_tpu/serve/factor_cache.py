@@ -497,6 +497,7 @@ class FactorCache:
                 attempt += 1
         if self.breaker is not None:
             self.breaker.record_success(key)
+        lu = self._condition_check(a, options, lu, plan)
         if self.store is not None:
             try:
                 self.store.save(key, lu)
@@ -505,6 +506,45 @@ class FactorCache:
                 # (disk full, perms) must not fail the request that
                 # just paid a real factorization
                 self.metrics.inc("factor_store.save_errors")
+        return lu
+
+    def _condition_check(self, a, options, lu, plan):
+        """Eager condition gate on the serve factorization path
+        (SLU_COND_ESTIMATE=1, numerics/): estimate rcond off the
+        fresh factors — a handful of refinement-free packed-trisolve
+        dispatches, zero extra factorizations — refuse a numerically
+        singular key typed (SingularMatrixError, never cached, never
+        a garbage solve), and climb ONE precision rung before the
+        first serve when the key classifies ill-conditioned.  Off (the
+        default) this is one env read per factorization."""
+        from ..numerics.gscon import ensure_rcond
+        from ..numerics.policy import ConditionPolicy, \
+            cond_estimate_enabled
+        if not cond_estimate_enabled():
+            return lu
+        opts = options if options is not None else \
+            lu.effective_options
+        policy = ConditionPolicy.from_env()
+        rcond = ensure_rcond(lu)
+        cls = policy.classify(rcond, opts.refine_dtype)
+        if cls == "ill" and getattr(opts, "escalate", False):
+            from ..precision.policy import next_factor_dtype
+            cur = lu.effective_options.factor_dtype
+            nxt = next_factor_dtype(cur, ceiling=opts.refine_dtype)
+            if nxt is not None:
+                from .. import obs
+                self.metrics.inc("factor_cache.cond_escalations")
+                obs.HEALTH.record_escalation(
+                    berr=0.0, factor_dtype=cur,
+                    refine_dtype=opts.refine_dtype, to_dtype=nxt,
+                    trigger="ill_conditioned")
+                lu = self._factorize_fn(
+                    a, opts.replace(factor_dtype=nxt), plan)
+                ensure_rcond(lu)
+        # floor refusal comes AFTER the rung climb: the higher-rung
+        # estimate is the honest one
+        policy.enforce(lu.rcond, opts.refine_dtype,
+                       where=" (serve factor path)")
         return lu
 
     def resident_stale(self, key: CacheKey

@@ -57,8 +57,10 @@ from ..resilience.store import FactorStore
 from ..sparse import CSRMatrix
 from .batcher import BUCKET_LADDER, MicroBatcher
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
-                     FactorPoisoned, FlusherDead, ServeError,
-                     ServeRejected, StaleFactorError, factor_cost_hint)
+                     FactorPoisoned, FlusherDead, InvalidInputError,
+                     ServeError, ServeRejected, SingularMatrixError,
+                     StaleFactorError, StructurallySingularError,
+                     factor_cost_hint)
 from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
@@ -86,6 +88,29 @@ def _merged_solve_fn(options: Options, metrics: Metrics | None = None,
 
     def fn(lu: LUFactorization, B):
         x, st, merged = raw(lu, B)
+        # perturbation/condition stamp (numerics/): a solve that rode
+        # tiny-pivot-replaced factors — or an ill-conditioned key
+        # under SLU_COND_POLICY=stamp — is labeled PerturbedResult.
+        # The batcher's per-request column slices inherit the stamp
+        # (PerturbedResult.__array_finalize__).  Cost when clean: two
+        # getattr, nothing else.
+        led = getattr(lu, "ledger", None)
+        rc = getattr(lu, "rcond", None)
+        if (led is not None and led.perturbed) or rc is not None:
+            from ..numerics.ledger import stamp_perturbed
+            from ..numerics.policy import ConditionPolicy
+            pol = ConditionPolicy.from_env()
+            ill = (pol.mode == "stamp" and pol.classify(
+                rc, merged.refine_dtype) == "ill")
+            if (led is not None and led.perturbed) or ill:
+                x = stamp_perturbed(x, ledger=led, rcond=rc)
+                flight.batch_event(
+                    "perturbed",
+                    tiny_pivots=(int(led.count) if led is not None
+                                 else 0),
+                    rcond=(float(rc) if rc is not None else None))
+                if metrics is not None:
+                    metrics.inc("serve.perturbed_served")
         if merged.iter_refine != IterRefine.NOREFINE:
             # per-request linkage: the batcher bound this dispatch's
             # flight records before calling us (batch_begin), so the
@@ -434,6 +459,11 @@ class SolveService:
             if self._pending_fin:
                 self._drain_observability()
         try:
+            # front-door validation (numerics/): malformed or poisoned
+            # inputs are refused typed BEFORE admission — they must
+            # never consume a queue slot, a batcher dispatch, or (for
+            # a cold CSRMatrix) a factorization
+            self._validate_request(a, b)
             with self._lock:
                 if self._closed:
                     raise ServeError("service is closed")
@@ -515,6 +545,23 @@ class SolveService:
 
     # -- internals -----------------------------------------------------
 
+    @staticmethod
+    def _validate_request(a, b) -> None:
+        """Typed front-door input validation.  A CSRMatrix submit gets
+        the full driver gate (dimensions + finite A and b); a keyed
+        submit — where n is not known until cache lookup — still gets
+        the finite/non-empty b checks."""
+        if isinstance(a, CSRMatrix):
+            from ..models.gssvx import _validate_system
+            _validate_system(a, b)
+            return
+        bb = np.asarray(b)
+        if bb.size == 0 or bb.ndim not in (1, 2):
+            raise InvalidInputError(
+                f"right-hand side has shape {bb.shape}")
+        if not bool(np.isfinite(bb).all()):
+            raise InvalidInputError("non-finite entries in b")
+
     def _release(self, _future) -> None:
         with self._lock:
             self._inflight -= 1
@@ -533,7 +580,14 @@ class SolveService:
                           (FlusherDead, "flusher_dead"),
                           (FactorMissError, "miss_failfast"),
                           (StaleFactorError, "stale_rejected"),
-                          (ServeError, "serve_error")):
+                          (ServeError, "serve_error"),
+                          # numerical-trust refusals (numerics/):
+                          # typed, and each its own loadgen status —
+                          # a singular matrix is not a serve fault
+                          (InvalidInputError, "invalid_input"),
+                          (StructurallySingularError,
+                           "structurally_singular"),
+                          (SingularMatrixError, "singular")):
             if isinstance(e, cls):
                 return name
         return "error"
@@ -652,7 +706,7 @@ class SolveService:
                     mb = self._batcher_for(
                         t_key, t_lu, t_opts,
                         on_berr=self._tier_guard(
-                            key, t_key, t_opts),
+                            key, t_key, t_opts, t_lu),
                         variant=("tier",))
                     try:
                         return mb.submit(b, deadline=deadline)
@@ -801,7 +855,7 @@ class SolveService:
         return t_key, t_lu, t_opts
 
     def _tier_guard(self, requested_key: CacheKey, t_key: CacheKey,
-                    t_opts: Options):
+                    t_opts: Options, t_lu: LUFactorization | None = None):
         """Per-dispatch berr watchdog for tier-served traffic: berr
         above the sold accuracy class (the gssvx escalation gate,
         64·eps(refine_dtype)) blocks the tier mapping — a health
@@ -810,7 +864,15 @@ class SolveService:
         genuine full-precision factorization."""
         from .. import obs
         from ..models.gssvx import _ESC_BERR_SLACK
-        limit = _ESC_BERR_SLACK * float(
+        from ..numerics.policy import ConditionPolicy
+        # ill-conditioned keys get a TIGHTER accuracy guard (slack /
+        # SLU_COND_SLACK_DIV): high-kappa systems are exactly where a
+        # berr sitting just under the generic 64-eps gate can still
+        # hide a large forward error
+        slack = ConditionPolicy.from_env().berr_slack(
+            _ESC_BERR_SLACK, getattr(t_lu, "rcond", None),
+            t_opts.refine_dtype)
+        limit = slack * float(
             np.finfo(np.dtype(t_opts.refine_dtype)).eps)
 
         def on_berr(berr: float) -> None:
@@ -856,7 +918,7 @@ class SolveService:
         try:
             mb = self._batcher_for(
                 s_key, handle, d_opts,
-                on_berr=self._degraded_guard(key, d_opts),
+                on_berr=self._degraded_guard(key, d_opts, s_lu),
                 # per-(requested values) variant: each drifted value
                 # set refines against ITS matrix and must not share a
                 # batch (or a handle) with another's
@@ -901,7 +963,8 @@ class SolveService:
         return d
 
     def _degraded_guard(self, requested_key: CacheKey,
-                        d_opts: Options):
+                        d_opts: Options,
+                        lu: LUFactorization | None = None):
         """berr watchdog for degraded dispatches — the same accuracy
         class the tier guard enforces (64·eps(refine_dtype)): a
         degraded answer whose refinement could not close the
@@ -910,7 +973,14 @@ class SolveService:
         `degraded_berr` health escalation."""
         from .. import obs
         from ..models.gssvx import _ESC_BERR_SLACK
-        limit = _ESC_BERR_SLACK * float(
+        from ..numerics.policy import ConditionPolicy
+        # same condition-aware tightening as the tier guard: degraded
+        # serving of an ill-conditioned key has the least margin of
+        # any path in the service
+        slack = ConditionPolicy.from_env().berr_slack(
+            _ESC_BERR_SLACK, getattr(lu, "rcond", None),
+            d_opts.refine_dtype)
+        limit = slack * float(
             np.finfo(np.dtype(d_opts.refine_dtype)).eps)
 
         def on_berr(berr: float) -> None:

@@ -28,6 +28,12 @@ schedule.  Three triggers, checked cheapest-first:
               generation (optional; drift in berr is the primary
               signal, but a bounded-staleness policy can insist).
 
+plus `rcond_drift` (between berr_trip and drift, SLU_COND_ESTIMATE
+only): the estimated rcond of the newest generation has fallen
+SLU_STREAM_RCOND_DRIFT x below the stream's first-generation
+baseline — the PROBLEM is hardening toward singularity, which berr
+alone can miss right up to the cliff (numerics/, ISSUE 15).
+
 plus a MIN INTERVAL between refactor starts — `interval_scale` x the
 factorization cost — bounding the background duty cycle so a noisy
 berr series cannot turn the pipeline into a hot loop of 477 s
@@ -68,6 +74,8 @@ def _defaults() -> dict:
         "interval_scale": flags.env_float("SLU_STREAM_INTERVAL_SCALE",
                                           1.0),
         "max_lag": flags.env_int("SLU_STREAM_MAX_LAG", 0),
+        "rcond_drift": flags.env_float("SLU_STREAM_RCOND_DRIFT",
+                                       100.0),
     }
 
 
@@ -90,8 +98,17 @@ class Cadence:
                                else float(interval_scale))
         self.max_lag = d["max_lag"] if max_lag is None else int(max_lag)
         self.trip = self.trip_frac * self.guard_limit
+        # conditioning drift (numerics/, ISSUE 15): refactor when the
+        # live values' estimated rcond has fallen `rcond_drift`x below
+        # the generation-0 baseline — berr measures how well refinement
+        # covers the drift, rcond measures how much the PROBLEM itself
+        # has hardened; a matrix drifting toward singularity can keep
+        # berr low right up to the cliff
+        self.rcond_drift = d["rcond_drift"]
         self._lock = threading.Lock()
         self._traj: list[tuple[float, float]] = []   # (mono, berr)
+        self._rcond0: float | None = None    # baseline at last swap
+        self._rcond_last: float | None = None
         self._last_start: float | None = None
         self._measured_wall_s: float | None = None   # EWMA
         # deterministic per-replica phase jitter (fleet only): spreads
@@ -113,6 +130,18 @@ class Cadence:
         with self._lock:
             self._traj.append((now, float(berr)))
             del self._traj[:-_TRAJ_CAP]
+
+    def note_rcond(self, rcond: float | None) -> None:
+        """One generation's condition estimate (the pipeline feeds
+        this at prime and after each swap, when SLU_COND_ESTIMATE has
+        populated the handle).  The first estimate after a swap is the
+        new baseline; later estimates are compared against it."""
+        if rcond is None:
+            return
+        with self._lock:
+            if self._rcond0 is None:
+                self._rcond0 = float(rcond)
+            self._rcond_last = float(rcond)
 
     def note_refactor_start(self, now: float | None = None) -> None:
         with self._lock:
@@ -153,9 +182,9 @@ class Cadence:
     def due(self, lag: int = 0,
             now: float | None = None) -> str | None:
         """Should a refactorization start now?  Returns the trigger
-        name ('berr_trip' | 'drift' | 'lag') or None.  `lag` is how
-        many steps the live values are past the resident generation
-        (0 = fresh: nothing to do)."""
+        name ('berr_trip' | 'rcond_drift' | 'drift' | 'lag') or None.
+        `lag` is how many steps the live values are past the resident
+        generation (0 = fresh: nothing to do)."""
         if lag <= 0:
             return None
         now = time.monotonic() if now is None else now
@@ -164,6 +193,7 @@ class Cadence:
         with self._lock:
             last_start = self._last_start
             traj = list(self._traj)
+            rc0, rc_last = self._rcond0, self._rcond_last
         if (last_start is not None
                 and now - last_start < self.min_interval_s()):
             return None
@@ -173,6 +203,14 @@ class Cadence:
             return None
         if traj[-1][1] >= self.trip:
             return "berr_trip"
+        if (rc0 is not None and rc_last is not None
+                and self.rcond_drift > 1.0
+                and rc_last <= rc0 / self.rcond_drift):
+            # the problem itself has hardened rcond_drift x since the
+            # stream's first generation: refactor eagerly — refinement
+            # against stale factors has less margin per unit of value
+            # drift the closer the matrix sits to singular
+            return "rcond_drift"
         slope = self._slope(traj)
         if slope > 0.0:
             # lookahead: will berr reach the trip level before a
@@ -205,8 +243,12 @@ class Cadence:
             traj = list(self._traj)
             last_start = self._last_start
             wall = self._measured_wall_s
+            rc0, rc_last = self._rcond0, self._rcond_last
         return {
             "trip": self.trip,
+            "rcond_drift": self.rcond_drift,
+            "rcond0": rc0,
+            "rcond_last": rc_last,
             "guard_limit": self.guard_limit,
             "trip_frac": self.trip_frac,
             "interval_scale": self.interval_scale,

@@ -177,6 +177,10 @@ class StreamHandle:
         # refine it by EWMA.  A warm store adopt under-estimates —
         # the first real refactor corrects it.
         self.cadence.note_swap(time.monotonic() - t0)
+        # condition baseline (numerics/): under SLU_COND_ESTIMATE the
+        # serve factor path cached an rcond on the handle; generation
+        # 1's estimate is the stream's drift baseline
+        self.cadence.note_rcond(getattr(lu, "rcond", None))
         self._gen_count = 1
         self.swap.publish(Generation(gen=1, key=key, lu=lu, a=a,
                                      step=0))
@@ -195,6 +199,14 @@ class StreamHandle:
         refactorization starts.  `key` skips the O(nnz) fingerprint
         when the caller already computed `matrix_key(a_new,
         h.options)` (the scipy-compat hot path)."""
+        # chaos site (drill-only): deterministic value-skew toward
+        # rank deficiency — the hardening-problem fault the
+        # rcond-drift trigger exists for.  Off-path cost: one pointer
+        # check.  A skewed matrix is a NEW value set, so the key is
+        # recomputed from it.
+        a_skew = chaos.maybe_skew_singular("near_singular", a_new)
+        if a_skew is not a_new:
+            a_new, key = a_skew, None
         if key is None:
             key = matrix_key(a_new, self.options)
         if key.pattern_key != self._pattern_key:
@@ -638,6 +650,9 @@ class StreamHandle:
         g = self.swap.publish(Generation(gen=gen_no, key=key, lu=lu,
                                          a=a, step=step))
         self.cadence.note_swap(wall)
+        # the fresh generation's condition estimate (when the serve
+        # factor path computed one) feeds the rcond-drift trigger
+        self.cadence.note_rcond(getattr(lu, "rcond", None))
         self.metrics.inc("stream.swaps")
         obs.instant("stream.swap", cat="stream",
                     args={"gen": g.gen, "step": step,

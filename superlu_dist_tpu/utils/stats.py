@@ -109,6 +109,14 @@ class Stats:
         default_factory=dict)
     comm_measured: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
+    # per-factorization detail (ISSUE 15): one {tiny_pivots, dtype}
+    # record per factorize() under this Stats, so a multi-factor run
+    # (escalation ladder, SamePattern refresh) shows WHICH
+    # factorization perturbed, not just a blended total
+    factor_events: list = dataclasses.field(default_factory=list)
+    # condition estimate of the LAST factorization served through this
+    # run (numerics/gscon.ensure_rcond), None when not estimated
+    rcond: float | None = None
 
     @contextlib.contextmanager
     def timer(self, phase: str):
@@ -126,6 +134,13 @@ class Stats:
 
     def add_ops(self, phase: str, flops: float) -> None:
         self.ops[phase] = self.ops.get(phase, 0.0) + flops
+
+    def note_factor_event(self, *, tiny_pivots: int = 0,
+                          dtype: str = "") -> None:
+        """One factorization's per-run record (called from
+        models/gssvx.factorize)."""
+        self.factor_events.append({"tiny_pivots": int(tiny_pivots),
+                                   "dtype": str(dtype)})
 
     def set_measured_cost(self, phase: str, cost: dict | None) -> None:
         """Adopt an XLA cost-analysis record ({flops, bytes}) for ONE
@@ -166,6 +181,8 @@ class Stats:
             "lu_nnz": self.lu_nnz,
             "lu_bytes": self.lu_bytes,
             "comm_predicted": dict(self.comm_predicted),
+            "factor_events": [dict(e) for e in self.factor_events],
+            "rcond": self.rcond,
         }
 
     def report(self) -> str:
@@ -180,7 +197,16 @@ class Stats:
                 line += f"  {self.gflops(p):8.2f} GF/s"
             lines.append(line)
         lines.append(f"  tiny pivots replaced: {self.tiny_pivots}")
+        if len(self.factor_events) > 1 or any(
+                e["tiny_pivots"] for e in self.factor_events):
+            # per-factorization breakdown: which run perturbed
+            per = ", ".join(
+                f"#{i} {e['dtype'] or '?'}: {e['tiny_pivots']}"
+                for i, e in enumerate(self.factor_events))
+            lines.append(f"    per factorization:  {per}")
         lines.append(f"  refinement steps:     {self.refine_steps}")
+        if self.rcond is not None:
+            lines.append(f"  estimated rcond:      {self.rcond:.2e}")
         # process-wide compile + health telemetry (obs/): the jit
         # caches and the health monitor are process-scoped like the
         # compile caches themselves, so the report shows the process

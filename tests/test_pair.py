@@ -175,7 +175,11 @@ def test_pair_singular_raises(problem):
     n = 12
     d = np.ones(n, np.complex128)
     d[7] = 0.0
-    A = sp.diags(d).tocsr()
+    # store the zero pivot EXPLICITLY (diags().tocsr() drops it, and a
+    # pattern-empty row/column is now refused typed at plan time —
+    # this test's teeth are the pair FACTOR path's zero division)
+    idx = np.arange(n)
+    A = sp.csr_matrix((d, (idx, idx)), shape=(n, n))
     a = csr_from_scipy(A)
     opts = Options(factor_dtype="complex128", replace_tiny_pivot=False,
                    equil=False, row_perm=RowPerm.NOROWPERM)

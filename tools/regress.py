@@ -195,6 +195,9 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "FLEET.jsonl")):
         if rec.get("mode") == "fleet":
             add(rec.get("platform"), "fleet", rec)
+    for rec in _read_jsonl(os.path.join(root, "GAUNTLET.jsonl")):
+        if rec.get("mode") == "gauntlet":
+            add(rec.get("platform"), "gauntlet", rec)
     for rec in _bench_records(root):
         add(rec.get("platform"), "bench", rec)
     return hist
@@ -451,6 +454,23 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the stream drill gate itself "
                     "failed"))
+            elif chk == "gauntlet":
+                gate = latest.get("gate", {})
+                zero_check(p, chk, "silent_wrong",
+                           float(gate.get("silent_wrong", 0)),
+                           "a hard-matrix case produced a plain "
+                           "unstamped result with garbage backward "
+                           "error — the silent wrong answer")
+                zero_check(p, chk, "untyped",
+                           float(gate.get("untyped", 0)),
+                           "a gauntlet refusal escaped the typed "
+                           "taxonomy")
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the hard-matrix gauntlet gate "
+                    "itself failed"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -512,6 +532,8 @@ def build_baselines(history: dict, tolerances: dict | None = None,
             elif chk == "fleet":
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "stream":
+                dst[chk] = {}          # structural zero-gates only
+            elif chk == "gauntlet":
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "bench":
                 dst[chk] = {"gflops": _median(

@@ -115,6 +115,7 @@ CONTRACT_MODULES = (
     "superlu_dist_tpu.ops.spmv",
     "superlu_dist_tpu.ops.batched",
     "superlu_dist_tpu.precision.doubleword",
+    "superlu_dist_tpu.numerics.gscon",
 )
 
 

@@ -37,7 +37,7 @@ _PRECONDITION = {"ValueError", "TypeError", "KeyError", "IndexError",
 def _serve_scope(path: str) -> bool:
     parts = path.split("/")
     return ("serve" in parts or "resilience" in parts
-            or "stream" in parts)
+            or "stream" in parts or "numerics" in parts)
 
 
 def check(tree, src, path, ann):

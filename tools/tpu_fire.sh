@@ -137,6 +137,16 @@ stamp "smoke rc=$? -> $smoke_out"
 SLU_REGRESS=0 timeout 600 python -m tools.fleet_drill >> "$log" 2>&1
 stamp "fleet drill rc=$?"
 
+# 3c. Hard-matrix gauntlet — the numerical-robustness gate (kappa
+#     ladder to 1/eps, singular/poisoned/malformed corpus; bench.py
+#     --gauntlet appends to GAUNTLET.jsonl and exits nonzero on any
+#     silent-wrong answer or untyped refusal).  Small systems, no
+#     device-scale work — runs in the dryrun too.  SLU_REGRESS=0 for
+#     the same reason as 3b: the full sentinel runs at the end.
+SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --gauntlet \
+  >> "$log" 2>&1
+stamp "gauntlet rc=$?"
+
 # Everything below step 3 runs on hardware only: the sweep's scale
 # configs compile for many minutes even staged.  The CPU rehearsal's
 # budget claim is steps 1 and 3 (bench + smoke; step 2's profile is
