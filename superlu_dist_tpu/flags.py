@@ -90,6 +90,9 @@ FLAGS: dict[str, str] = {
     "SLU_COND_STAMP": "ill-conditioned classification threshold on rcond (default 0 = auto: sqrt(eps(refine_dtype))); below it the policy mode engages, the serve berr guard tightens by SLU_COND_SLACK_DIV, and the escalation ladder climbs a rung before first serve",
     "SLU_COND_SLACK_DIV": "divisor applied to the 64-eps berr guard slack for keys classified ill-conditioned (default 8: guard tightens to 8*eps) — high-kappa keys get less refinement slack, not more",
     # --- resilience (resilience/, serve/factor_cache.py) ---
+    "SLU_BREAKER_THRESHOLD": "per-key circuit-breaker failure threshold (resilience/breaker.py; default 3): this many consecutive lead-factorization failures open the circuit; 0 at the ServeConfig layer disables the breaker entirely",
+    "SLU_BREAKER_COOLDOWN_S": "circuit-breaker open-state cooldown seconds (default 30): requests during the cooldown get an immediate FactorPoisoned, then ONE half-open probe is admitted — success closes, failure re-opens for another cooldown",
+    "SLU_COST_HINT_MAX_AGE_S": "staleness horizon on the factor_cost_hint_s trajectory (serve/errors.py; default 2592000 = 30 days, 0 disables): SOLVE_LATENCY.jsonl records older than this are ignored when sizing fleet lease TTLs and stream cadence, so neither ever sizes itself off a weeks-old measurement; with no fresh record the callers' conservative fallback applies",
     "SLU_FT_STORE": "durable factor-store directory: FactorCache write-through/read-through persistence tier (atomic rename + sha256 framing + per-array ABFT checksum; corrupt entries quarantined to *.quarantined, never served; a restarted replica boots warm)",
     "SLU_CHAOS": "fault-injection spec 'site=prob[:param],...' — sites: factor_raise, factor_nan, store_flip, flusher_raise, latency (param = sleep seconds), store_latency, lease_steal, replica_kill, refactor_raise, refactor_slow, swap_kill (the stream pipeline's background-failure + mid-swap-crash sites), near_singular (param = skew strength: deterministic value-skew of incoming stream values toward rank deficiency, the rcond-drift drill's fault); deterministic per-site seeded streams; every site is one pointer check when unset",
     "SLU_CHAOS_SEED": "chaos RNG seed (default 0): same spec+seed replays the identical failure sequence",
@@ -105,6 +108,16 @@ FLAGS: dict[str, str] = {
     "SLU_FLEET_K": "fleet drill grid size k (3D Laplacian, n=k^3; default 4)",
     "SLU_FLEET_OUT": "fleet drill record path (default FLEET.jsonl)",
     "SLU_FLEET_KILL_AFTER": "fraction of the drill's load phase served before the victim replica is kill -9'd (default 0.33)",
+    # --- elastic fleet controller (fleet/policy.py, fleet/controller.py, tools/fleet_drill.py --day) ---
+    "SLU_FLEET_BURN_HIGH": "SLO burn rate at or above which the controller scales up and sheds low-weight tenants (default 2.0 — the window is burning error budget at twice the allowed rate)",
+    "SLU_FLEET_BURN_LOW": "SLO burn rate at or below which the controller may retire a surplus replica (default 0.25); between the low and high marks the fleet holds steady (hysteresis)",
+    "SLU_FLEET_MIN_REPLICAS": "floor on live replica count — the controller never retires below it (default 1)",
+    "SLU_FLEET_MAX_REPLICAS": "ceiling on live replica count — the controller never spawns past it (default 8)",
+    "SLU_FLEET_SCALE_COOLDOWN_S": "minimum seconds between controller scaling actions in either direction (default 60) — capacity transitions are scheduled events, never oscillation",
+    "SLU_FLEET_PREFACTOR_MIN": "demand count at which a non-resident pattern key becomes a prefactor target (default 2): the controller schedules warming at the key's ring home through the lease single-flight path",
+    "SLU_FLEET_DAY_OUT": "day-in-the-life drill record path (tools/fleet_drill.py --day; default FLEET_DAY.jsonl)",
+    "SLU_FLEET_DAY_REQUESTS": "day drill base request count per load phase (default 32; the diurnal curve scales each phase off this)",
+    "SLU_FLEET_DAY_P99_MS": "day drill per-phase p99 ceiling in ms (default 10000): a structural hang/cliff bound across every transition, generous to timeshared-box noise",
     "SLU_SERVE_BLAS_THREADS": "host BLAS pool size pinned by the first SolveService, process-wide (default 1; 0 = leave the pool alone; needs threadpoolctl, silently no-op without it) — a multi-threaded OpenBLAS pool's spin-wait barriers let one caller monopolize every core, so a background refactorization's host BLAS stalls concurrent solves (stream overlap A/B measured 1.45x p99 before the pin, 1.05x after); zero per-request overhead (one-time pool resize)",
     # --- streaming refactorization (stream/, tools/serve_bench.py --stream) ---
     "SLU_STREAM_TRIP": "stream cadence escalation threshold as a fraction of the hard berr-guard limit (default 0.25): a stale solve's refined berr past trip_frac x 64·eps(refine_dtype) fires the stream_drift health escalation and requests a background refactorization; the hard limit itself always withholds the result (typed StaleFactorError, never served past the guard)",

@@ -37,15 +37,48 @@ mid-load, gating zero lost/hung requests, warm takeover with zero
 survivor factorizations for published keys, and exactly one
 fleet-wide factorization per cold key — committed as FLEET.jsonl and
 baselined in tools/regress.py.
+
+ISSUE 16 adds the ELASTIC layer on the same substrate:
+
+  * `policy.py` — signals in, typed actions out: SLO-burn-driven
+    autoscale with hysteresis + cooldown, popularity-driven
+    prefactor of hot-but-cold keys at their ring homes, weighted
+    multi-tenant shed (QosGate, refusing typed with TenantThrottled).
+  * `scaler.py` — durable membership (`<name>.member` files beside
+    the store), the arc-move receipt for every ring change, and the
+    retire protocol: drain → demote → release-leases → stop.
+  * `controller.py` — the gather → decide → actuate loop tying them
+    together; any one actuation may fail, the loop never does.
+
+Proven by `tools/fleet_drill.py --day`: a day-in-the-life drill —
+diurnal load, tenant mix, a flash crowd, rolling restarts, one
+replica kill — gating zero lost requests, every shed typed, policy
+prefactor at exactly one factorization per cold key, and zero
+takeover factorizations; committed as FLEET_DAY.jsonl and baselined
+in tools/regress.py.
 """
 
+from .controller import FleetController, signals_from
 from .lease import FleetCoordinator, LeaseInfo
+from .policy import (FleetPolicy, FleetSignals, PolicyConfig, QosGate,
+                     weighted_shed)
 from .pool import ReplicaPool
 from .router import HashRing
+from .scaler import MembershipDirectory, ReplicaScaler, arc_moves
 
 __all__ = [
+    "FleetController",
     "FleetCoordinator",
+    "FleetPolicy",
+    "FleetSignals",
     "HashRing",
     "LeaseInfo",
+    "MembershipDirectory",
+    "PolicyConfig",
+    "QosGate",
     "ReplicaPool",
+    "ReplicaScaler",
+    "arc_moves",
+    "signals_from",
+    "weighted_shed",
 ]

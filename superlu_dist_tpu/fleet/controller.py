@@ -1,0 +1,154 @@
+"""The elastic fleet controller loop (ISSUE 16).
+
+gather → decide → actuate, on a cadence.  Three injected roles keep
+the loop itself trivial (and testable with plain functions):
+
+  * `gather() -> FleetSignals` — reads the world: worst SLO burn
+    across keys (obs/slo.py), the factor cache's demand ledger
+    joined against the ring (FactorCache.popularity +
+    HashRing.home), live membership, breaker states.
+  * `FleetPolicy.decide(signals) -> [actions]` — policy.py; all the
+    judgment, none of the I/O.
+  * actuator — anything with `prefactor(action)`, `scale_up(action)`,
+    `retire(action)`, `shed(action)`.  The drill's actuator speaks
+    the replica wire protocol; the in-process one calls
+    SolveService.prefactor and QosGate.set_fractions directly; a
+    test's actuator appends to a list.
+
+Every actuation is metered and every failure contained: one broken
+prefactor (the key's breaker is open, the home is mid-restart) must
+not stop the shed decision that shares its tick — the controller is
+exactly the component that must keep working while things break.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .policy import (FleetPolicy, FleetSignals, Prefactor, Retire,
+                     ScaleUp, Shed)
+
+
+class FleetController:
+    def __init__(self, policy: FleetPolicy, gather, actuator,
+                 metrics=None, clock=time.monotonic) -> None:
+        self.policy = policy
+        self._gather = gather
+        self._actuator = actuator
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._errors = 0
+        self._last_signals: FleetSignals | None = None
+        self._last_actions: list = []
+        self._counts = {"prefactor": 0, "scale_up": 0, "retire": 0,
+                        "shed_on": 0, "shed_off": 0}
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def tick(self) -> list:
+        """One gather → decide → actuate pass; returns the actions
+        taken (the drill asserts on them).  A failing actuation is
+        counted and skipped, never propagated — the next action in
+        the same tick still runs."""
+        signals = self._gather()
+        actions = self.policy.decide(signals)
+        for act in actions:
+            try:
+                if isinstance(act, Prefactor):
+                    self._actuator.prefactor(act)
+                    self._counts["prefactor"] += 1
+                    self._inc("controller.prefactor")
+                elif isinstance(act, ScaleUp):
+                    self._actuator.scale_up(act)
+                    self._counts["scale_up"] += 1
+                    self._inc("controller.scale_up")
+                elif isinstance(act, Retire):
+                    self._actuator.retire(act)
+                    self._counts["retire"] += 1
+                    self._inc("controller.retire")
+                elif isinstance(act, Shed):
+                    self._actuator.shed(act)
+                    key = "shed_on" if act.fractions else "shed_off"
+                    self._counts[key] += 1
+            except Exception:       # noqa: BLE001 — contained: the
+                self._errors += 1   # loop outlives any one actuation
+                self._inc("controller.actuation_errors")
+        with self._lock:
+            self._ticks += 1
+            self._last_signals = signals
+            self._last_actions = actions
+        return actions
+
+    def run(self, stop: threading.Event,
+            interval_s: float = 1.0) -> None:
+        """Blocking control loop until `stop` is set (run it on a
+        thread).  A tick that raises in GATHER is counted and the
+        loop continues — same containment stance as actuation."""
+        while not stop.wait(interval_s):
+            try:
+                self.tick()
+            except Exception:       # noqa: BLE001
+                self._errors += 1
+                self._inc("controller.tick_errors")
+
+    def snapshot(self) -> dict:
+        """Operator view: tick/action/error counts, the last
+        signals (burn, membership, breaker by_state), the last
+        decisions."""
+        with self._lock:
+            sig = self._last_signals
+            return {
+                "ticks": self._ticks,
+                "errors": self._errors,
+                "actions": dict(self._counts),
+                "burn": sig.burn if sig is not None else None,
+                "replicas": list(sig.replicas) if sig is not None
+                else [],
+                "breaker_by_state": dict(sig.breaker_by_state)
+                if sig is not None else {},
+                "last_actions": [type(a).__name__
+                                 for a in self._last_actions],
+            }
+
+
+def signals_from(service, ring=None, replicas=(),
+                 top: int = 16) -> FleetSignals:
+    """Build FleetSignals from an in-process SolveService: worst burn
+    across the SLO snapshot, the cache's demand ledger joined against
+    `ring` (HashRing over the pool's `_route_key` strings), the
+    breaker's by_state.  The single-process gatherer — the drill's
+    multi-process one speaks the replica wire protocol instead but
+    fills the same dataclass."""
+    from ..obs import slo
+
+    burn = 0.0
+    if slo.enabled():
+        for key, rec in slo.snapshot().get("keys", {}).items():
+            # "unrouted" collects front-door refusals — including the
+            # QoS gate's own sheds — as failures with no ok traffic
+            # ever landing there.  Feeding it back as burn latches the
+            # shed permanently (shed → burn → more shed); the
+            # controller's signal is SERVED-traffic health only
+            if key == "unrouted":
+                continue
+            for dim in ("burn_rate_availability", "burn_rate_latency"):
+                v = rec.get(dim)
+                if v is not None:
+                    burn = max(burn, float(v))
+    popularity = []
+    for ent in service.cache.popularity(top=top):
+        home = ""
+        if ring is not None:
+            from .pool import _route_key
+            home = ring.home(_route_key(ent["key"]))
+        popularity.append({**ent, "home": home})
+    br = service.cache.breaker
+    by_state = br.snapshot()["by_state"] if br is not None else {}
+    return FleetSignals(burn=burn, replicas=tuple(replicas),
+                        popularity=tuple(popularity),
+                        breaker_by_state=by_state)

@@ -256,6 +256,26 @@ class FleetCoordinator:
             except OSError:
                 pass
 
+    def release_all(self) -> None:
+        """Release every lease this coordinator still owns — the
+        retire protocol's last step (fleet/scaler.py: drain → demote
+        → release-leases).  A retiring replica that exits holding
+        leases forces its successors through the TTL-expiry + steal
+        path; releasing hands the keys over immediately.  Scans the
+        lease DIRECTORY, not just the heartbeat registry — a lease
+        acquired but not yet (or no longer) heartbeating is still
+        ours to hand back."""
+        with self._hb_lock:
+            held = set(self._beats)
+        try:
+            for fn in os.listdir(self.root):
+                if fn.endswith(LEASE_SUFFIX):
+                    held.add(fn[:-len(LEASE_SUFFIX)])
+        except OSError:
+            pass
+        for name in held:
+            self.release(name)      # no-op unless the lease is OURS
+
     # -- heartbeat -----------------------------------------------------
 
     def _start_heartbeat(self, name: str,

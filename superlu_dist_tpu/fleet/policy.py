@@ -1,0 +1,327 @@
+"""Fleet policy: signals in, typed actions out (ISSUE 16).
+
+The controller loop (controller.py) is deliberately dumb — gather,
+decide, actuate.  Everything that could be WRONG lives here, in pure
+functions over plain data, so every decision is unit-testable with an
+injected clock and no replica processes:
+
+  * `FleetPolicy.decide(signals)` — SLO-burn-driven autoscale with
+    hysteresis (scale up at `burn_high`, back down only below
+    `burn_low` — the gap prevents flapping) and a scale cooldown so
+    one hot window cannot spawn a replica per tick; popularity-driven
+    prefactor for hot-but-cold pattern keys at their ring homes;
+    weighted tenant shed while the burn is high.
+  * `weighted_shed(burn, weights)` — how much of each tenant's
+    traffic to drop: low-weight tenants absorb the overload first,
+    and a weight-1.0 tenant is never shed at all.
+  * `QosGate` — the admission-side enforcement the service consults
+    (ServeConfig.qos): deterministic fractional shed per tenant plus
+    optional token buckets, refusing with TenantThrottled — a typed
+    shed, a subclass of ServeRejected so the never-reroute economics
+    apply unchanged.
+
+Signals are a plain dataclass (`FleetSignals`) so the drill, the
+in-process helper (controller.signals_from) and the tests all build
+them the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from .. import flags
+from ..serve.errors import TenantThrottled
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyConfig:
+    """The policy knobs, each routed through flags.py so an operator
+    tunes the fleet without redeploying (`from_env`); explicit
+    constructor values win, as everywhere."""
+
+    burn_high: float = 2.0       # SLO burn rate that triggers scale-up/shed
+    burn_low: float = 0.25       # burn rate below which scale-down/unshed
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_cooldown_s: float = 60.0   # min spacing between scale actions
+    prefactor_min: int = 2       # demand count that makes a cold key "hot"
+    # tenant -> weight in [0, 1]: 1.0 = never shed, 0.0 = shed first.
+    # Unlisted tenants get DEFAULT_WEIGHT.
+    tenant_weights: dict = dataclasses.field(default_factory=dict)
+
+    DEFAULT_WEIGHT = 0.5
+
+    @classmethod
+    def from_env(cls, **overrides) -> "PolicyConfig":
+        vals = dict(
+            burn_high=flags.env_float("SLU_FLEET_BURN_HIGH", 2.0),
+            burn_low=flags.env_float("SLU_FLEET_BURN_LOW", 0.25),
+            min_replicas=flags.env_int("SLU_FLEET_MIN_REPLICAS", 1),
+            max_replicas=flags.env_int("SLU_FLEET_MAX_REPLICAS", 8),
+            scale_cooldown_s=flags.env_float(
+                "SLU_FLEET_SCALE_COOLDOWN_S", 60.0),
+            prefactor_min=flags.env_int("SLU_FLEET_PREFACTOR_MIN", 2),
+        )
+        vals.update(overrides)
+        return cls(**vals)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One tick's observed world, gathered by the controller.
+
+    `popularity` entries are dicts with at least {"key", "count",
+    "resident", "home"} — the factor-cache demand ledger
+    (FactorCache.popularity) joined against the ring
+    (HashRing.home(route_key)) by the gatherer.  `burn` is the worst
+    SLO burn rate across keys and dimensions (obs/slo.py snapshot);
+    0.0 means "inside budget".  `replicas` is the live membership in
+    RETIREMENT order — the policy retires from the tail, so the
+    gatherer puts the elastic (most recently added) replicas last.
+    """
+
+    burn: float = 0.0
+    replicas: tuple = ()
+    popularity: tuple = ()
+    breaker_by_state: dict = dataclasses.field(default_factory=dict)
+
+
+# -- actions (what decide() returns) ----------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Prefactor:
+    """Warm `key` at its ring `home` — through the replica's
+    prefactor path, which runs the lease-file single-flight, so a
+    policy-driven warm is still exactly one fleet-wide
+    factorization."""
+    key: object
+    home: str
+    count: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleUp:
+    """Spawn one replica and hand it its ring arc."""
+    reason: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Retire:
+    """Retire `replica`: drain → demote from the ring → release its
+    leases → stop (fleet/scaler.py runs the protocol)."""
+    replica: str
+    reason: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """Set the QoS gate's per-tenant shed fractions ({} = shed off)."""
+    fractions: dict
+
+
+def weighted_shed(burn: float, weights: dict) -> dict:
+    """Per-tenant shed fractions for an SLO burn of `burn`.
+
+    The overload fraction — how much of the offered load is beyond
+    budget — is `1 - 1/burn` (burn 2.0 = spending budget twice as
+    fast = half the load must go).  Tenants absorb it in ascending
+    weight order, each capped at `1 - weight`, assuming equal load
+    shares (the gate has no per-tenant rate estimate): the batch
+    tier (weight 0) is fully sheddable and goes first; a weight-1.0
+    tenant's cap is 0 — premium traffic is NEVER shed by policy, it
+    can only be rejected by the queue-depth cap like anyone else.
+    """
+    if burn <= 1.0 or not weights:
+        return {}
+    overload = min(1.0, 1.0 - 1.0 / float(burn))
+    # equal-share assumption: overload fraction of TOTAL load equals
+    # `overload * n` tenant-load units to drop across n tenants
+    remaining = overload * len(weights)
+    fractions: dict = {}
+    for tenant, w in sorted(weights.items(), key=lambda kv: kv[1]):
+        cap = max(0.0, 1.0 - float(w))
+        take = min(cap, remaining)
+        if take > 0.0:
+            fractions[tenant] = take
+            remaining -= take
+    return fractions
+
+
+class FleetPolicy:
+    """decide(signals) -> [actions].  Stateful only where the control
+    loop needs memory: the scale cooldown stamp and the shed
+    hysteresis latch.  The clock is injectable so tests drive the
+    cooldown without sleeping."""
+
+    def __init__(self, config: PolicyConfig | None = None,
+                 clock=time.monotonic) -> None:
+        self.config = config or PolicyConfig.from_env()
+        self._clock = clock
+        self._last_scale_at: float | None = None
+        self._shedding = False
+
+    def _cooldown_ok(self, now: float) -> bool:
+        return (self._last_scale_at is None
+                or now - self._last_scale_at
+                >= self.config.scale_cooldown_s)
+
+    def decide(self, signals: FleetSignals) -> list:
+        cfg = self.config
+        now = self._clock()
+        actions: list = []
+
+        # 1) popularity-driven prefactor: hot demand with no resident
+        # factors anywhere gets warmed at its ring home.  Always on —
+        # warming is cheap to DECIDE (the single-flight makes it cheap
+        # to act on, too: a key someone else warmed is one probe).
+        for ent in signals.popularity:
+            if ent.get("resident"):
+                continue
+            if int(ent.get("count", 0)) < cfg.prefactor_min:
+                continue
+            actions.append(Prefactor(key=ent["key"],
+                                     home=ent.get("home", ""),
+                                     count=int(ent.get("count", 0))))
+
+        # 2) shed with hysteresis: engage at burn_high, release only
+        # below burn_low — between the thresholds the latch holds, so
+        # a burn oscillating around the trigger doesn't flap tenants
+        # in and out of service
+        if signals.burn >= cfg.burn_high:
+            self._shedding = True
+        elif signals.burn <= cfg.burn_low:
+            self._shedding = False
+        if self._shedding:
+            actions.append(Shed(weighted_shed(signals.burn,
+                                              cfg.tenant_weights)))
+        else:
+            actions.append(Shed({}))
+
+        # 3) autoscale, behind the cooldown: shed is instantaneous
+        # relief, capacity is the cure — both fire on the same signal
+        n = len(signals.replicas)
+        if (signals.burn >= cfg.burn_high and n < cfg.max_replicas
+                and self._cooldown_ok(now)):
+            self._last_scale_at = now
+            actions.append(ScaleUp(
+                reason=f"burn {signals.burn:.2f} >= {cfg.burn_high}"))
+        elif (signals.burn <= cfg.burn_low and n > cfg.min_replicas
+                and self._cooldown_ok(now)):
+            self._last_scale_at = now
+            actions.append(Retire(
+                replica=signals.replicas[-1],
+                reason=f"burn {signals.burn:.2f} <= {cfg.burn_low}"))
+        return actions
+
+
+class _TenantState:
+    __slots__ = ("acc", "admitted", "shed", "tokens", "rate", "burst",
+                 "last_fill")
+
+    def __init__(self) -> None:
+        self.acc = 0.0
+        self.admitted = 0
+        self.shed = 0
+        self.tokens = None      # None = no bucket configured
+        self.rate = 0.0
+        self.burst = 0.0
+        self.last_fill = 0.0
+
+
+class QosGate:
+    """Admission-side multi-tenant QoS (ServeConfig.qos).
+
+    Two independent mechanisms, both refusing with TenantThrottled:
+
+      * fractional shed — `set_fractions({tenant: f})`, normally
+        driven by the controller's Shed action.  DETERMINISTIC, not
+        sampled: an error accumulator per tenant (acc += f; shed when
+        acc >= 1) so a fraction of 0.25 sheds exactly every 4th
+        request — reproducible in tests and fair over small windows.
+      * token buckets — `set_bucket(tenant, rate, burst)` caps a
+        tenant's steady-state admission rate regardless of policy;
+        the bucket refills continuously on the injected clock.
+
+    Unlabeled requests (tenant=None) belong to the "default" tenant.
+    """
+
+    def __init__(self, clock=time.monotonic, metrics=None) -> None:
+        self._clock = clock
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _TenantState] = {}
+        self._fractions: dict[str, float] = {}
+
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self._tenants[tenant] = _TenantState()
+        return st
+
+    def set_fractions(self, fractions: dict) -> None:
+        """Replace the shed table (controller Shed action; {} = off).
+        Accumulators reset when a tenant's shed LIFTS, so a lifted
+        tenant doesn't shed its first post-recovery request off a
+        stale accumulator."""
+        with self._lock:
+            for tenant in self._fractions:
+                if tenant not in fractions:
+                    st = self._tenants.get(tenant)
+                    if st is not None:
+                        st.acc = 0.0
+            self._fractions = {str(t): float(f)
+                               for t, f in fractions.items()
+                               if f > 0.0}
+
+    def set_bucket(self, tenant: str, rate: float,
+                   burst: float) -> None:
+        """Cap `tenant` at `rate` admissions/s with `burst` headroom."""
+        with self._lock:
+            st = self._state(str(tenant))
+            st.rate = float(rate)
+            st.burst = float(burst)
+            st.tokens = float(burst)
+            st.last_fill = self._clock()
+
+    def admit(self, tenant: str | None) -> None:
+        """Admit or raise TenantThrottled.  Called by the service
+        front door before a queue slot is consumed."""
+        t = str(tenant) if tenant is not None else "default"
+        with self._lock:
+            st = self._state(t)
+            frac = self._fractions.get(t, 0.0)
+            if frac > 0.0:
+                st.acc += frac
+                if st.acc >= 1.0:
+                    st.acc -= 1.0
+                    st.shed += 1
+                    if self._metrics is not None:
+                        self._metrics.inc("qos.shed")
+                    raise TenantThrottled(
+                        f"tenant {t!r} shed at fraction {frac:.2f} "
+                        f"under SLO burn")
+            if st.tokens is not None:
+                now = self._clock()
+                st.tokens = min(st.burst, st.tokens
+                                + st.rate * (now - st.last_fill))
+                st.last_fill = now
+                if st.tokens < 1.0:
+                    st.shed += 1
+                    if self._metrics is not None:
+                        self._metrics.inc("qos.shed")
+                    raise TenantThrottled(
+                        f"tenant {t!r} out of admission tokens "
+                        f"(rate {st.rate:g}/s)")
+                st.tokens -= 1.0
+            st.admitted += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "fractions": dict(self._fractions),
+                "tenants": {t: {"admitted": st.admitted,
+                                "shed": st.shed}
+                            for t, st in self._tenants.items()},
+            }

@@ -1,0 +1,159 @@
+"""Replica lifecycle through the shared durable store (ISSUE 16).
+
+Autoscale needs three things the rest of fleet/ already half-owns:
+
+  * membership the whole fleet can SEE — `MembershipDirectory`, one
+    `<name>.member` JSON file per replica in a directory beside the
+    store, written with the same atomic-rename discipline as the
+    store itself (utils/io.atomic_write_bytes), so a reader never
+    sees a torn record and a crashed replica's file survives for the
+    controller to reap;
+  * predictable ring movement — `arc_moves(old, new, keys)` computes
+    exactly which keys change home between two memberships, reusing
+    HashRing so the answer is the SAME pure function every client
+    routes by (consistent hashing bounds it to ~1/n of the keyspace
+    per membership change — the pinned Karger arc-stability
+    property);
+  * a retire protocol that never strands work — `ReplicaScaler`:
+    spawn announces then delegates to the injected `spawn_fn`; retire
+    runs drain (mark draining in membership, tell the replica to
+    finish in-flight work and `FleetCoordinator.release_all()` its
+    leases) → demote (drop from membership, so new rings exclude it)
+    → stop.  The actuation functions are injected — the drill drives
+    real processes over its wire protocol, tests drive dicts — the
+    ORDER is what this module owns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..utils.io import atomic_write_bytes
+from .router import HashRing
+
+_SUFFIX = ".member"
+
+
+class MembershipDirectory:
+    """Durable fleet membership: `<name>.member` JSON files.
+
+    States: "up" (serving, in the ring) and "draining" (finishing
+    in-flight work, OUT of any ring built from `ring_members()`).
+    A record is {"replica", "state", "ts", **meta}.
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, f"{name}{_SUFFIX}")
+
+    def announce(self, name: str, state: str = "up",
+                 **meta) -> None:
+        rec = {"replica": str(name), "state": str(state),
+               "ts": time.time()}
+        rec.update(meta)
+        atomic_write_bytes(self._path(name),
+                           json.dumps(rec).encode())
+
+    def remove(self, name: str) -> None:
+        try:
+            os.unlink(self._path(name))
+        except OSError:
+            pass
+
+    def members(self) -> dict[str, dict]:
+        """All parseable records, name -> record.  A torn or foreign
+        file is skipped, never fatal — membership must stay readable
+        through any single writer's crash."""
+        out: dict[str, dict] = {}
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for fn in sorted(names):
+            if not fn.endswith(_SUFFIX):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            name = str(rec.get("replica") or fn[:-len(_SUFFIX)])
+            out[name] = rec
+        return out
+
+    def ring_members(self) -> list[str]:
+        """Names eligible for routing: state == "up", announce
+        order-independent (sorted — HashRing sorts anyway, this keeps
+        the retirement-order contract to the caller's own list)."""
+        return sorted(n for n, rec in self.members().items()
+                      if rec.get("state") == "up")
+
+
+def arc_moves(old: HashRing | None, new: HashRing,
+              keys) -> list[tuple]:
+    """(key, old_home, new_home) for every key whose home changes
+    between the two rings (`old` None = everything is new).  The
+    controller logs this on every scale action: consistent hashing
+    promises the moved set is the joining/leaving replica's arc and
+    nothing else, and this is the receipt."""
+    moves = []
+    for k in keys:
+        nh = new.home(k)
+        oh = old.home(k) if old is not None else None
+        if oh != nh:
+            moves.append((k, oh, nh))
+    return moves
+
+
+class ReplicaScaler:
+    """Spawn/retire driver.  `spawn_fn(name)` must start a replica
+    that announces itself ready; `drain_fn(name)` must tell it to
+    stop accepting new work and release its fleet leases
+    (FleetCoordinator.release_all); `stop_fn(name)` terminates it.
+    All three are injected — process management belongs to the
+    caller, the PROTOCOL belongs here."""
+
+    def __init__(self, membership: MembershipDirectory,
+                 spawn_fn, drain_fn, stop_fn,
+                 metrics=None) -> None:
+        self.membership = membership
+        self._spawn = spawn_fn
+        self._drain = drain_fn
+        self._stop = stop_fn
+        self._metrics = metrics
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def scale_up(self, name: str, **meta) -> None:
+        """Spawn `name` and announce it up.  The announce happens
+        AFTER the spawn function returns (which should imply
+        readiness): a replica must never appear in ring_members()
+        before it can serve its arc."""
+        self._spawn(name)
+        self.membership.announce(name, state="up", **meta)
+        self._inc("fleet.scale_up")
+
+    def retire(self, name: str) -> None:
+        """Drain → demote → release-leases → stop.
+
+        Order matters twice: membership flips to "draining" FIRST so
+        every ring built from ring_members() already excludes the
+        retiree while it finishes in-flight work (new traffic routes
+        to the survivors, who adopt the retiree's published factors
+        from the store); and the drain — which releases the replica's
+        leases — completes BEFORE stop, so no successor ever has to
+        wait out a dead replica's lease TTL."""
+        self.membership.announce(name, state="draining")
+        try:
+            self._drain(name)
+        finally:
+            self._stop(name)
+            self.membership.remove(name)
+        self._inc("fleet.retire")

@@ -14,12 +14,29 @@ three-state breaker, keyed per cache key.
 The clock is injectable so tests drive the open→half-open→closed
 cycle without sleeping.  State transitions tick a metrics counter and
 an obs trace instant when wired (duck-typed: anything with `inc`).
+
+The constructor defaults route through flags.py
+(`SLU_BREAKER_THRESHOLD` / `SLU_BREAKER_COOLDOWN_S`), so an operator
+tunes breaker pressure fleet-wide without touching every ServeConfig;
+explicit constructor arguments still win.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+
+from .. import flags
+
+
+def default_threshold() -> int:
+    """`SLU_BREAKER_THRESHOLD`, default 3."""
+    return flags.env_int("SLU_BREAKER_THRESHOLD", 3)
+
+
+def default_cooldown_s() -> float:
+    """`SLU_BREAKER_COOLDOWN_S`, default 30 s."""
+    return flags.env_float("SLU_BREAKER_COOLDOWN_S", 30.0)
 
 
 class _KeyState:
@@ -35,10 +52,13 @@ class _KeyState:
 
 
 class CircuitBreaker:
-    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+    def __init__(self, threshold: int | None = None,
+                 cooldown_s: float | None = None,
                  clock=time.monotonic, metrics=None) -> None:
-        self.threshold = int(threshold)
-        self.cooldown_s = float(cooldown_s)
+        self.threshold = int(threshold if threshold is not None
+                             else default_threshold())
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else default_cooldown_s())
         self._clock = clock
         self._metrics = metrics
         self._lock = threading.Lock()

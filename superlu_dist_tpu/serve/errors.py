@@ -15,8 +15,11 @@ from __future__ import annotations
 import functools
 import json
 import os
+import time
 
 import numpy as np
+
+from .. import flags
 
 # the numerical-trust taxonomy (numerics/errors.py) re-exported here
 # so service callers import ONE failure vocabulary; numerics/ sits
@@ -38,6 +41,16 @@ class ServeRejected(ServeError):
     """Admission control refused the request: the queue-depth cap was
     reached.  Explicit pushback beats unbounded queueing — the caller
     should shed or retry with backoff."""
+
+
+class TenantThrottled(ServeRejected):
+    """Multi-tenant QoS shed (fleet/policy.py QosGate): the tenant's
+    admission tokens ran dry, or the fleet controller ordered a
+    weighted shed for this tenant under SLO burn.  A subclass of
+    ServeRejected on purpose — the same deadline-economics taxonomy
+    applies (never rerouted along the ring, the caller backs off) —
+    but its own type so a shed is distinguishable from a full queue
+    in every status ledger."""
 
 
 class DeadlineExceeded(ServeError):
@@ -99,11 +112,43 @@ def _record_factor_arm(rec: dict) -> str | None:
     return str(fa) if fa else None
 
 
+def _record_epoch(rec: dict) -> float | None:
+    """Epoch seconds of a record's `ts` stamp, or None when absent or
+    unparseable (age unknown — the staleness horizon cannot judge
+    it)."""
+    ts = rec.get("ts")
+    if not ts:
+        return None
+    try:
+        return time.mktime(time.strptime(str(ts),
+                                         "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, OverflowError):
+        return None
+
+
+# default staleness horizon on the measured trajectory (ISSUE 16):
+# a lease TTL or stream cadence must never size itself off a
+# weeks-old measurement — the fleet it guards has long since changed
+_COST_HINT_MAX_AGE_S = 30 * 86400.0
+
+
 @functools.lru_cache(maxsize=8)
-def _factor_cost_from(path: str, arm: str | None) -> float | None:
+def _factor_cost_from(path: str, arm: str | None,
+                      max_age_s: float = 0.0) -> float | None:
     """Latest t_factor_s in `path`, preferring the freshest record
-    measured under `arm`; falls back to the freshest record of any
-    arm (pre-arm history, or an arm with no record yet).
+    measured under `arm`.  With an arm requested, records STAMPED
+    with a different arm are ignored — a merged-arm timing says
+    nothing honest about the legacy arm's cold wall (the arms differ
+    up to the whole dispatch-granularity lever) — and only unstamped
+    pre-ISSUE-12 history may stand in when the arm has no record yet.
+    No eligible record -> None, and the caller's conservative
+    fallback applies.
+
+    `max_age_s` > 0 is the staleness horizon
+    (`SLU_COST_HINT_MAX_AGE_S`): records stamped older than the
+    horizon are skipped outright; records with no parseable `ts`
+    (test fixtures, hand-written history) are exempt — the horizon
+    guards the stamped trajectory, it cannot judge an unknown age.
 
     mode="factor_ab" rows are EXCLUDED: their t_factor_s is a WARM
     in-process numeric-sweep timing (best-of interleaved passes,
@@ -112,7 +157,8 @@ def _factor_cost_from(path: str, arm: str | None) -> float | None:
     must outlive — plan build + compile-or-deserialize + the sweep.
     Adopting the warm figure would collapse lease TTLs ~170x below
     the cost they guard and invite mid-factorization lease steals."""
-    last_any = last_arm = None
+    cutoff = (time.time() - max_age_s) if max_age_s > 0 else None
+    last_any = last_same = last_bare = None
     try:
         with open(path) as f:
             for line in f:
@@ -125,12 +171,22 @@ def _factor_cost_from(path: str, arm: str | None) -> float | None:
                 t = rec.get("t_factor_s")
                 if not t:
                     continue
-                last_any = float(t)
-                if arm is not None and _record_factor_arm(rec) == arm:
-                    last_arm = float(t)
+                if cutoff is not None:
+                    epoch = _record_epoch(rec)
+                    if epoch is not None and epoch < cutoff:
+                        continue       # weeks-old: never size off it
+                v = float(t)
+                last_any = v
+                ra = _record_factor_arm(rec)
+                if ra is None:
+                    last_bare = v
+                if arm is not None and ra == arm:
+                    last_same = v
     except OSError:
         pass
-    return last_arm if last_arm is not None else last_any
+    if arm is None:
+        return last_any
+    return last_same if last_same is not None else last_bare
 
 
 def factor_cost_hint_s(arm: str | None = None) -> float | None:
@@ -145,7 +201,15 @@ def factor_cost_hint_s(arm: str | None = None) -> float | None:
     factor arm (ops/batched.factor_arm — legacy|merged|merged+pallas)
     and prefers the freshest record measured under it, so a merged-arm
     speedup SHRINKS lease TTLs instead of inheriting legacy-arm costs
-    (and an arm rollback re-inherits the honest slower figure)."""
+    (and an arm rollback re-inherits the honest slower figure).
+
+    Staleness-guarded (ISSUE 16): records older than the
+    `SLU_COST_HINT_MAX_AGE_S` horizon (default 30 days) and records
+    stamped under a DIFFERENT arm are ignored — with nothing fresh
+    and arm-honest left, this returns None and the caller's
+    conservative default applies (the lease TTL fallback, the stream
+    cadence floor) rather than a figure measured on a fleet that no
+    longer exists."""
     if arm is None:
         try:
             from ..ops.batched import factor_arm
@@ -156,7 +220,10 @@ def factor_cost_hint_s(arm: str | None = None) -> float | None:
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), "SOLVE_LATENCY.jsonl")
-    return _factor_cost_from(path, arm)
+    return _factor_cost_from(
+        path, arm,
+        flags.env_float("SLU_COST_HINT_MAX_AGE_S",
+                        _COST_HINT_MAX_AGE_S))
 
 
 @functools.lru_cache(maxsize=1)

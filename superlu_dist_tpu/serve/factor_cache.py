@@ -206,6 +206,15 @@ class FactorCache:
             collections.OrderedDict()
         self._inflight: dict[CacheKey, _Flight] = {}
         self.bytes_resident = 0
+        # demand ledger (ISSUE 16): per-key request counts noted by
+        # the service on EVERY routed request — hit, inline miss, and
+        # fail-fast miss alike — so the fleet controller can see which
+        # PATTERNS are hot before they are resident and prefactor them
+        # at their ring homes.  Bounded recency-ordered dict: the cold
+        # tail falls off, the hot head is what policy reads.
+        self._popularity: "collections.OrderedDict[CacheKey, int]" = \
+            collections.OrderedDict()
+        self._popularity_cap = 256
 
     # -- introspection -------------------------------------------------
 
@@ -247,6 +256,30 @@ class FactorCache:
             "fleet_waits": m.counter("fleet.waits"),
             "fleet_steals": m.counter("fleet.steals"),
         }
+
+    # -- demand ledger (ISSUE 16) --------------------------------------
+
+    def note_demand(self, key: CacheKey) -> None:
+        """Record one request's demand for `key` (hit or miss — the
+        service calls this on every routed request).  Feeds
+        `popularity()`, the fleet controller's prefactor signal."""
+        with self._lock:
+            self._popularity[key] = self._popularity.get(key, 0) + 1
+            self._popularity.move_to_end(key)
+            while len(self._popularity) > self._popularity_cap:
+                self._popularity.popitem(last=False)
+
+    def popularity(self, top: int = 16) -> list[dict]:
+        """The hottest keys by demand count, hottest first.  Each
+        entry: {"key": CacheKey, "count": int, "resident": bool} —
+        `resident` lets policy skip keys already factored, so the
+        prefactor loop only spends on genuinely cold demand."""
+        with self._lock:
+            ranked = sorted(self._popularity.items(),
+                            key=lambda kv: kv[1], reverse=True)[:top]
+            return [{"key": k, "count": c,
+                     "resident": k in self._entries}
+                    for k, c in ranked]
 
     # -- core ----------------------------------------------------------
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, ServeError,
-                     ServeRejected, StaleFactorError)
+                     ServeRejected, StaleFactorError, TenantThrottled)
 from .service import SolveService
 
 
@@ -147,6 +147,10 @@ def _status_of_solve(do_solve) -> tuple[str, object]:
     taxonomy.  Returns (status, x-or-None)."""
     try:
         x = do_solve()
+    except TenantThrottled:
+        # BEFORE ServeRejected (its base class): a QoS shed is policy
+        # doing its job, not a full queue
+        return "shed", None
     except ServeRejected:
         return "rejected", None
     except DeadlineExceeded:

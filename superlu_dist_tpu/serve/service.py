@@ -51,6 +51,7 @@ from .. import flags
 from ..models.gssvx import LUFactorization, solve
 from ..obs import flight, slo
 from ..options import Options, merge_solve_options, solve_options_key
+from ..resilience import breaker as breaker_defaults
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import RetryPolicy
 from ..resilience.store import FactorStore
@@ -60,7 +61,7 @@ from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, InvalidInputError,
                      ServeError, ServeRejected, SingularMatrixError,
                      StaleFactorError, StructurallySingularError,
-                     factor_cost_hint)
+                     TenantThrottled, factor_cost_hint)
 from .factor_cache import CacheKey, FactorCache, matrix_key
 from .metrics import Metrics
 
@@ -205,9 +206,12 @@ class ServeConfig:
     retry_base_s: float = 0.05
     # per-key circuit breaker: this many lead-factorization failures
     # open the circuit for cooldown_s (then one half-open probe);
-    # 0 disables
-    breaker_threshold: int = 3
-    breaker_cooldown_s: float = 30.0
+    # 0 disables.  Defaults route through flags.py
+    # (SLU_BREAKER_THRESHOLD / SLU_BREAKER_COOLDOWN_S)
+    breaker_threshold: int = dataclasses.field(
+        default_factory=breaker_defaults.default_threshold)
+    breaker_cooldown_s: float = dataclasses.field(
+        default_factory=breaker_defaults.default_cooldown_s)
     # degraded-mode serving: when a refactorization fails (or the key
     # is circuit-broken) but a stale same-pattern factorization is
     # resident, solve through it with refinement against the FRESH
@@ -221,6 +225,12 @@ class ServeConfig:
     # adopt the published entry.  SLU_FLEET=1 flips the default.
     fleet: bool = dataclasses.field(
         default_factory=lambda: bool(flags.env_int("SLU_FLEET", 0)))
+    # multi-tenant QoS gate (fleet/policy.py QosGate, duck-typed:
+    # anything with admit(tenant)): consulted at the front door for
+    # requests carrying a tenant= label; a refusal raises
+    # TenantThrottled — typed shed, never rerouted.  None = no gate,
+    # tenant labels pass through unexamined.
+    qos: object | None = None
 
 
 _BLAS_LIMITED = False
@@ -433,7 +443,8 @@ class SolveService:
                options: Options | None = None,
                deadline_s: float | None = None,
                _t0: float | None = None,
-               _router=None) -> Future:
+               _router=None,
+               tenant: str | None = None) -> Future:
         """Admit one solve request; resolves to x.  `a` may be the
         matrix itself or a CacheKey from prefactor() (keyed submits
         skip fingerprint hashing on the hot path).  `_t0` is the
@@ -464,6 +475,15 @@ class SolveService:
             # never consume a queue slot, a batcher dispatch, or (for
             # a cold CSRMatrix) a factorization
             self._validate_request(a, b)
+            # multi-tenant QoS (fleet/policy.py): the gate refuses
+            # BEFORE a queue slot is consumed — a shed tenant's
+            # request must cost the service nothing but this check
+            if self.config.qos is not None:
+                try:
+                    self.config.qos.admit(tenant)
+                except TenantThrottled:
+                    self.metrics.inc("serve.shed")
+                    raise
             with self._lock:
                 if self._closed:
                     raise ServeError("service is closed")
@@ -512,7 +532,8 @@ class SolveService:
               options: Options | None = None,
               deadline_s: float | None = None,
               info: dict | None = None,
-              _router=None) -> np.ndarray:
+              _router=None,
+              tenant: str | None = None) -> np.ndarray:
         """Blocking submit; respects the deadline while waiting.
         Pass `info={}` to receive out-of-band request metadata —
         currently `info['request_id']`, the flight-recorder rid (None
@@ -523,7 +544,7 @@ class SolveService:
         t0 = time.monotonic()
         try:
             future = self.submit(a, b, options, deadline_s, _t0=t0,
-                                 _router=_router)
+                                 _router=_router, tenant=tenant)
         except BaseException as e:
             if info is not None:
                 info["request_id"] = getattr(e, "request_id", None)
@@ -574,7 +595,11 @@ class SolveService:
         matters: every serve error derives from ServeError)."""
         if e is None:
             return "ok"
-        for cls, name in ((ServeRejected, "rejected"),
+        for cls, name in ((TenantThrottled, "shed"),
+                          # TenantThrottled SUBCLASSES ServeRejected:
+                          # the shed must match first or it reads as a
+                          # full queue in every ledger
+                          (ServeRejected, "rejected"),
                           (DeadlineExceeded, "deadline"),
                           (FactorPoisoned, "poisoned"),
                           (FlusherDead, "flusher_dead"),
@@ -674,6 +699,10 @@ class SolveService:
         rec = flight.current()
         if isinstance(a, CacheKey):
             key = a
+            # demand ledger BEFORE the lookup: fail-fast misses are
+            # exactly the demand the fleet controller's prefactor
+            # policy exists to serve
+            self.cache.note_demand(key)
             # get(), not peek(): keyed submits ARE the hot path, and
             # the recorded hit rate must reflect them
             lu = self.cache.get(key)
@@ -691,6 +720,7 @@ class SolveService:
                     options = self._prefactor_opts.get(key)
         else:
             key = matrix_key(a, options or Options())
+            self.cache.note_demand(key)
             resident = self.cache.peek(key, touch=False) is not None
             if not resident and self.config.dtype_tiers:
                 tiered = self._tier_lookup(a, options or Options(),

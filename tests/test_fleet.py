@@ -135,6 +135,97 @@ def test_lease_steal_is_exclusive(tmp_path):
     assert co.read_lease("k") is None        # buried, not replaced
 
 
+def test_expired_lease_steal_one_winner_across_threads(tmp_path):
+    """The retire/kill cliff: a dead leader's lease expires and EVERY
+    waiting follower lunges at once.  Exactly one try_steal wins; the
+    losers re-enter the wait loop rather than double-burying."""
+    dead = FleetCoordinator(str(tmp_path), ttl_s=0.05,
+                            replica="dead-leader")
+    assert dead.try_acquire("k")
+    time.sleep(0.1)                          # no heartbeat: expires
+    lease = dead.read_lease("k")
+    assert lease is not None and lease.expired()
+    cos = [FleetCoordinator(str(tmp_path), ttl_s=30.0,
+                            replica=f"f{i}") for i in range(8)]
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def race(i):
+        barrier.wait()
+        if cos[i].try_steal("k"):
+            wins.append(i)
+
+    ts = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+    assert dead.read_lease("k") is None      # buried exactly once
+
+
+_WORKER_STEAL = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu.fleet import FleetCoordinator
+
+co = FleetCoordinator({store!r}, ttl_s=30.0,
+                      replica='stealer-' + str(os.getpid()))
+deadline = time.monotonic() + 60.0
+while not os.path.exists({go!r}):
+    if time.monotonic() > deadline:
+        sys.exit(3)
+    time.sleep(0.002)
+print('STEAL', int(co.try_steal('k')))
+"""
+
+
+def test_expired_lease_steal_one_winner_across_processes(tmp_path):
+    """Same cliff, real PROCESSES: rename(2) exclusivity is the
+    arbiter, so the one-winner property must hold without any shared
+    in-process lock."""
+    store = str(tmp_path)
+    go = os.path.join(store, "go-signal")
+    dead = FleetCoordinator(store, ttl_s=0.05, replica="dead-leader")
+    assert dead.try_acquire("k")
+    time.sleep(0.1)
+    code = _WORKER_STEAL.format(repo=_REPO, store=store, go=go)
+    procs = [subprocess.Popen([sys.executable, "-c", code],
+                              env=_subprocess_env(),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(4)]
+    time.sleep(0.5)                          # let all reach the spin
+    with open(go, "w") as f:
+        f.write("go")
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    wins = sum(int(so.split("STEAL", 1)[1].strip()) for so, _ in outs)
+    assert wins == 1, outs
+    assert dead.read_lease("k") is None
+
+
+def test_lease_release_all_drops_only_own_leases(tmp_path):
+    """release_all (the drain leg of retire): every lease THIS
+    coordinator holds is dropped and its heartbeats stop; another
+    replica's lease is untouched."""
+    mine = FleetCoordinator(str(tmp_path), ttl_s=30.0, replica="me")
+    theirs = FleetCoordinator(str(tmp_path), ttl_s=30.0,
+                              replica="them")
+    assert mine.try_acquire("a")
+    assert mine.try_acquire("b")
+    mine._start_heartbeat("a")
+    assert theirs.try_acquire("c")
+    mine.release_all()
+    assert mine.read_lease("a") is None
+    assert mine.read_lease("b") is None
+    with mine._hb_lock:
+        assert mine._beats == {}
+    lease = mine.read_lease("c")
+    assert lease is not None and lease.replica == "them"
+
+
 def test_lease_release_never_drops_anothers_lease(tmp_path):
     mine = FleetCoordinator(str(tmp_path), ttl_s=30.0,
                             replica="me")

@@ -1,0 +1,444 @@
+"""fleet/ elastic layer (ISSUE 16): policy decisions under injected
+clocks, the QoS gate's deterministic shed + token buckets, durable
+membership, the retire protocol's ordering, arc-move receipts, the
+controller loop's failure containment, and the service front door's
+tenant= shed path end-to-end."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import Options
+from superlu_dist_tpu.fleet import (FleetController, FleetPolicy,
+                                    FleetSignals, HashRing,
+                                    MembershipDirectory, PolicyConfig,
+                                    QosGate, ReplicaScaler, arc_moves,
+                                    signals_from, weighted_shed)
+from superlu_dist_tpu.fleet.policy import (Prefactor, Retire, ScaleUp,
+                                           Shed)
+from superlu_dist_tpu.obs import flight, slo
+from superlu_dist_tpu.serve import (FactorCache, ServeConfig,
+                                    SolveService, matrix_key)
+from superlu_dist_tpu.serve.errors import TenantThrottled
+from superlu_dist_tpu.serve.loadgen import _status_of_solve
+from superlu_dist_tpu.utils.testmat import laplacian_2d
+
+
+@pytest.fixture(autouse=True)
+def _isolated_globals():
+    flight.configure(enabled=False)
+    slo.configure(spec="")
+    yield
+    flight.configure(enabled=False)
+    slo.configure(spec="")
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------------------------
+# policy: config, weighted shed, hysteresis, cooldown, prefactor
+# --------------------------------------------------------------------
+
+def test_policy_config_from_env(monkeypatch):
+    monkeypatch.setenv("SLU_FLEET_BURN_HIGH", "3.5")
+    monkeypatch.setenv("SLU_FLEET_BURN_LOW", "0.5")
+    monkeypatch.setenv("SLU_FLEET_MIN_REPLICAS", "2")
+    monkeypatch.setenv("SLU_FLEET_MAX_REPLICAS", "5")
+    monkeypatch.setenv("SLU_FLEET_SCALE_COOLDOWN_S", "7")
+    monkeypatch.setenv("SLU_FLEET_PREFACTOR_MIN", "4")
+    cfg = PolicyConfig.from_env()
+    assert cfg.burn_high == 3.5
+    assert cfg.burn_low == 0.5
+    assert cfg.min_replicas == 2
+    assert cfg.max_replicas == 5
+    assert cfg.scale_cooldown_s == 7.0
+    assert cfg.prefactor_min == 4
+    # explicit constructor values win over the env, as everywhere
+    assert PolicyConfig.from_env(burn_high=9.0).burn_high == 9.0
+
+
+def test_weighted_shed_low_weight_absorbs_first():
+    w = {"premium": 1.0, "std": 0.5, "batch": 0.0}
+    # inside budget (or exactly at it): nothing shed
+    assert weighted_shed(0.5, w) == {}
+    assert weighted_shed(1.0, w) == {}
+    assert weighted_shed(5.0, {}) == {}
+    # burn 2.0: overload 0.5 of total = 1.5 tenant-units across 3
+    # tenants — batch (cap 1.0) takes 1.0, std (cap 0.5) takes 0.5,
+    # premium (cap 0) is NEVER shed
+    fr = weighted_shed(2.0, w)
+    assert fr == {"batch": 1.0, "std": 0.5}
+    assert "premium" not in fr
+    # milder burn: only the batch tier pays
+    fr = weighted_shed(1.25, w)        # overload 0.2 * 3 = 0.6 units
+    assert fr == {"batch": pytest.approx(0.6)}
+    # premium survives even an unbounded burn
+    assert "premium" not in weighted_shed(1e9, w)
+
+
+def test_policy_shed_hysteresis_latch():
+    clk = _FakeClock()
+    pol = FleetPolicy(PolicyConfig(
+        burn_high=2.0, burn_low=0.25, min_replicas=1, max_replicas=8,
+        scale_cooldown_s=0.0, tenant_weights={"batch": 0.0}), clock=clk)
+
+    def shed_of(actions):
+        [s] = [a for a in actions if isinstance(a, Shed)]
+        return s.fractions
+
+    # below burn_high: no shed
+    assert shed_of(pol.decide(FleetSignals(burn=1.5,
+                                           replicas=("r0",)))) == {}
+    # trips the latch
+    assert shed_of(pol.decide(FleetSignals(burn=2.5,
+                                           replicas=("r0",)))) != {}
+    # BETWEEN the thresholds the latch holds (no flapping)
+    assert shed_of(pol.decide(FleetSignals(burn=1.5,
+                                           replicas=("r0",)))) != {}
+    # only below burn_low does it release
+    assert shed_of(pol.decide(FleetSignals(burn=0.1,
+                                           replicas=("r0",)))) == {}
+
+
+def test_policy_autoscale_cooldown_and_bounds():
+    clk = _FakeClock()
+    pol = FleetPolicy(PolicyConfig(
+        burn_high=2.0, burn_low=0.25, min_replicas=1, max_replicas=2,
+        scale_cooldown_s=100.0), clock=clk)
+    hot = FleetSignals(burn=3.0, replicas=("r0",))
+    acts = pol.decide(hot)
+    assert [a for a in acts if isinstance(a, ScaleUp)]
+    # same signal inside the cooldown: shed persists, no second spawn
+    clk.t = 50.0
+    acts = pol.decide(hot)
+    assert not [a for a in acts if isinstance(a, ScaleUp)]
+    # cooldown elapsed but already at max_replicas: still no spawn
+    clk.t = 200.0
+    acts = pol.decide(FleetSignals(burn=3.0, replicas=("r0", "r1")))
+    assert not [a for a in acts if isinstance(a, ScaleUp)]
+    # cool burn retires from the TAIL of the retirement-ordered list,
+    # never below min_replicas
+    clk.t = 400.0
+    acts = pol.decide(FleetSignals(burn=0.1, replicas=("r0", "r1")))
+    [ret] = [a for a in acts if isinstance(a, Retire)]
+    assert ret.replica == "r1"
+    clk.t = 600.0
+    acts = pol.decide(FleetSignals(burn=0.1, replicas=("r0",)))
+    assert not [a for a in acts if isinstance(a, Retire)]
+
+
+def test_policy_prefactor_targets_hot_cold_keys_only():
+    pol = FleetPolicy(PolicyConfig(prefactor_min=2,
+                                   scale_cooldown_s=0.0),
+                      clock=_FakeClock())
+    sig = FleetSignals(burn=0.0, replicas=("r0", "r1"), popularity=(
+        {"key": "hot-cold", "count": 5, "resident": False,
+         "home": "r1"},
+        {"key": "hot-warm", "count": 9, "resident": True,
+         "home": "r0"},                       # resident: nothing to do
+        {"key": "cold-cold", "count": 1, "resident": False,
+         "home": "r0"},                       # below prefactor_min
+    ))
+    pre = [a for a in pol.decide(sig) if isinstance(a, Prefactor)]
+    assert len(pre) == 1
+    assert pre[0].key == "hot-cold" and pre[0].home == "r1"
+    assert pre[0].count == 5
+
+
+# --------------------------------------------------------------------
+# QosGate
+# --------------------------------------------------------------------
+
+def test_qos_fractional_shed_is_deterministic():
+    gate = QosGate(clock=_FakeClock())
+    gate.set_fractions({"batch": 0.25})
+    outcomes = []
+    for _ in range(8):
+        try:
+            gate.admit("batch")
+            outcomes.append("ok")
+        except TenantThrottled:
+            outcomes.append("shed")
+    # exactly every 4th request, not a coin flip
+    assert outcomes == ["ok", "ok", "ok", "shed"] * 2
+    snap = gate.snapshot()
+    assert snap["tenants"]["batch"] == {"admitted": 6, "shed": 2}
+    assert snap["fractions"] == {"batch": 0.25}
+    # an unlisted tenant (and the unlabeled default) always passes
+    gate.admit("premium")
+    gate.admit(None)
+    assert gate.snapshot()["tenants"]["default"]["admitted"] == 1
+
+
+def test_qos_accumulator_resets_when_shed_lifts():
+    gate = QosGate(clock=_FakeClock())
+    gate.set_fractions({"batch": 0.9})
+    gate.admit("batch")                       # acc 0.9, admitted
+    gate.set_fractions({})                    # shed lifts: acc reset
+    gate.set_fractions({"batch": 0.9})
+    gate.admit("batch")                       # must NOT shed off the
+    snap = gate.snapshot()                    # stale 0.9 accumulator
+    assert snap["tenants"]["batch"]["shed"] == 0
+
+
+def test_qos_token_bucket_caps_rate():
+    clk = _FakeClock()
+    gate = QosGate(clock=clk)
+    gate.set_bucket("api", rate=1.0, burst=2.0)
+    gate.admit("api")
+    gate.admit("api")                         # burst drained
+    with pytest.raises(TenantThrottled):
+        gate.admit("api")
+    clk.t = 1.0                               # 1 s refills 1 token
+    gate.admit("api")
+    # refill never exceeds the burst ceiling
+    clk.t = 100.0
+    gate.admit("api")
+    gate.admit("api")
+    with pytest.raises(TenantThrottled):
+        gate.admit("api")
+
+
+# --------------------------------------------------------------------
+# membership + scaler
+# --------------------------------------------------------------------
+
+def test_membership_directory_states_and_torn_files(tmp_path):
+    mem = MembershipDirectory(str(tmp_path))
+    mem.announce("r0", state="up", port=1234)
+    mem.announce("r1", state="up")
+    mem.announce("r2", state="draining")
+    with open(os.path.join(str(tmp_path), "torn.member"), "w") as f:
+        f.write('{"replica": "torn", "sta')     # torn write: skipped
+    members = mem.members()
+    assert set(members) == {"r0", "r1", "r2"}
+    assert members["r0"]["port"] == 1234
+    assert mem.ring_members() == ["r0", "r1"]   # draining excluded
+    mem.remove("r1")
+    mem.remove("r1")                            # idempotent
+    assert mem.ring_members() == ["r0"]
+    # the record is plain JSON another process can read
+    with open(os.path.join(str(tmp_path), "r0.member")) as f:
+        assert json.load(f)["state"] == "up"
+
+
+def test_arc_moves_is_the_karger_receipt():
+    keys = [f"k{i}" for i in range(256)]
+    old = HashRing(["r0", "r1", "r2"], vnodes=64)
+    new = old.with_replicas(["r0", "r1"])
+    moves = arc_moves(old, new, keys)
+    # exactly the retiree's arc moved, nothing else
+    assert moves and all(oh == "r2" for _, oh, _ in moves)
+    assert len(moves) == sum(1 for k in keys if old.home(k) == "r2")
+    # old=None: everything is an arrival
+    assert len(arc_moves(None, new, keys)) == len(keys)
+
+
+def test_scaler_retire_runs_drain_demote_stop_in_order(tmp_path):
+    mem = MembershipDirectory(str(tmp_path))
+    mem.announce("r0", state="up")
+    calls = []
+    states_at = {}
+
+    def drain(name):
+        # by drain time the retiree is already OUT of any new ring
+        states_at["drain"] = mem.members()[name]["state"]
+        calls.append(("drain", name))
+
+    scaler = ReplicaScaler(mem, spawn_fn=lambda n: calls.append(
+        ("spawn", n)), drain_fn=drain,
+        stop_fn=lambda n: calls.append(("stop", n)))
+    scaler.scale_up("r1")
+    assert mem.ring_members() == ["r0", "r1"]
+    assert calls == [("spawn", "r1")]
+
+    scaler.retire("r1")
+    assert calls == [("spawn", "r1"), ("drain", "r1"), ("stop", "r1")]
+    assert states_at["drain"] == "draining"
+    assert "r1" not in mem.members()
+
+
+def test_scaler_retire_stops_even_when_drain_fails(tmp_path):
+    mem = MembershipDirectory(str(tmp_path))
+    mem.announce("r0", state="up")
+    stopped = []
+
+    def drain(name):
+        raise RuntimeError("replica hung mid-drain")
+
+    scaler = ReplicaScaler(mem, spawn_fn=lambda n: None,
+                           drain_fn=drain,
+                           stop_fn=stopped.append)
+    with pytest.raises(RuntimeError):
+        scaler.retire("r0")
+    # the finally leg still terminated and demoted it
+    assert stopped == ["r0"]
+    assert "r0" not in mem.members()
+
+
+# --------------------------------------------------------------------
+# controller loop
+# --------------------------------------------------------------------
+
+class _ListActuator:
+    def __init__(self, fail_on=()):
+        self.calls = []
+        self.fail_on = set(fail_on)
+
+    def _do(self, kind, act):
+        if kind in self.fail_on:
+            raise RuntimeError(f"{kind} actuation broke")
+        self.calls.append((kind, act))
+
+    def prefactor(self, act):
+        self._do("prefactor", act)
+
+    def scale_up(self, act):
+        self._do("scale_up", act)
+
+    def retire(self, act):
+        self._do("retire", act)
+
+    def shed(self, act):
+        self._do("shed", act)
+
+
+def _hot_signals():
+    return FleetSignals(burn=3.0, replicas=("r0",), popularity=(
+        {"key": "k", "count": 5, "resident": False, "home": "r0"},),
+        breaker_by_state={"closed": 2})
+
+
+def test_controller_tick_contains_actuation_failures():
+    pol = FleetPolicy(PolicyConfig(
+        burn_high=2.0, scale_cooldown_s=0.0, prefactor_min=2,
+        tenant_weights={"batch": 0.0}), clock=_FakeClock())
+    act = _ListActuator(fail_on={"prefactor"})
+    ctl = FleetController(pol, gather=_hot_signals, actuator=act)
+    actions = ctl.tick()
+    # decide() emitted prefactor + shed + scale_up; the broken
+    # prefactor did NOT stop the later actions in the same tick
+    assert {type(a).__name__ for a in actions} \
+        == {"Prefactor", "Shed", "ScaleUp"}
+    assert [k for k, _ in act.calls] == ["shed", "scale_up"]
+    snap = ctl.snapshot()
+    assert snap["ticks"] == 1 and snap["errors"] == 1
+    assert snap["actions"]["scale_up"] == 1
+    assert snap["actions"]["prefactor"] == 0     # counted only on success
+    assert snap["burn"] == 3.0
+    assert snap["replicas"] == ["r0"]
+    assert snap["breaker_by_state"] == {"closed": 2}
+    assert "ScaleUp" in snap["last_actions"]
+
+
+def test_controller_run_loop_contains_gather_failures():
+    pol = FleetPolicy(PolicyConfig(), clock=_FakeClock())
+    calls = {"n": 0}
+
+    def gather():
+        calls["n"] += 1
+        raise RuntimeError("slo snapshot unavailable")
+
+    ctl = FleetController(pol, gather=gather,
+                          actuator=_ListActuator())
+    stop = threading.Event()
+    t = threading.Thread(target=ctl.run, args=(stop,),
+                         kwargs={"interval_s": 0.01})
+    t.start()
+    try:
+        deadline = 100
+        while calls["n"] < 3 and deadline:
+            deadline -= 1
+            stop.wait(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert calls["n"] >= 3                    # loop outlived the raises
+    assert ctl.snapshot()["errors"] >= 3
+
+
+def test_signals_from_in_process_service():
+    slo.configure(spec="p99_ms=10000,avail=0.999,window_s=60")
+    svc = SolveService(ServeConfig(backend="host"))
+    try:
+        a = laplacian_2d(6)
+        opts = Options()
+        key = matrix_key(a, opts)
+        svc.prefactor(a, opts)                # resident
+        for _ in range(3):
+            svc.solve(a, np.ones(a.n))
+        ring = HashRing(["r0", "r1"], vnodes=64)
+        sig = signals_from(svc, ring=ring, replicas=("r0", "r1"))
+        assert sig.replicas == ("r0", "r1")
+        assert sig.burn >= 0.0
+        ent = [e for e in sig.popularity if e["key"] == key]
+        assert ent and ent[0]["resident"]
+        assert ent[0]["count"] >= 3
+        assert ent[0]["home"] in ("r0", "r1")
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------
+# demand ledger + the tenant= front door
+# --------------------------------------------------------------------
+
+def test_cache_demand_ledger_ranks_and_caps():
+    cache = FactorCache(backend="host")
+    a, b = laplacian_2d(5), laplacian_2d(6)
+    ka, kb = matrix_key(a, Options()), matrix_key(b, Options())
+    for _ in range(3):
+        cache.note_demand(ka)
+    cache.note_demand(kb)
+    pop = cache.popularity()
+    assert [e["key"] for e in pop] == [ka, kb]
+    assert pop[0]["count"] == 3 and not pop[0]["resident"]
+    assert cache.popularity(top=1) == pop[:1]
+    # the ledger is bounded: hammering many keys evicts the oldest
+    cache._popularity_cap = 4
+    for i in range(8):
+        cache.note_demand(("synthetic", i))
+    assert len(cache.popularity(top=100)) == 4
+
+
+def test_service_tenant_shed_end_to_end():
+    gate = QosGate(clock=_FakeClock())
+    gate.set_fractions({"batch": 1.0})
+    svc = SolveService(ServeConfig(backend="host", qos=gate))
+    try:
+        a = laplacian_2d(6)
+        b = np.ones(a.n)
+        # premium passes, batch is refused TYPED before any queue
+        # slot or factorization is spent
+        x = svc.solve(a, b, tenant="premium")
+        assert np.all(np.isfinite(x))
+        with pytest.raises(TenantThrottled):
+            svc.solve(a, b, tenant="batch")
+        assert svc.metrics.counter("serve.shed") == 1
+        f0 = svc.cache.stats()["factorizations"]
+        with pytest.raises(TenantThrottled):
+            svc.solve(a, b, tenant="batch")
+        assert svc.cache.stats()["factorizations"] == f0
+        # the loadgen taxonomy counts it as "shed", never the blanket
+        # serve_error bucket
+        status, x = _status_of_solve(
+            lambda: svc.solve(a, b, tenant="batch"))
+        assert status == "shed" and x is None
+        # no gate configured: tenant labels pass through unexamined
+    finally:
+        svc.close()
+    svc2 = SolveService(ServeConfig(backend="host"))
+    try:
+        assert np.all(np.isfinite(
+            svc2.solve(a, b, tenant="batch")))
+    finally:
+        svc2.close()

@@ -177,6 +177,88 @@ def test_corrupt_baselines_fail(tmp_path):
 
 
 # --------------------------------------------------------------------
+# the day-in-the-life drill record (FLEET_DAY.jsonl)
+# --------------------------------------------------------------------
+
+def _day_record(**over):
+    """A green fleet_day record; override fields to break it."""
+    rec = {"mode": "fleet_day", "platform": "cpu",
+           "lost": 0, "hung": 0, "unaccounted": 0,
+           "takeover_factorizations": 0,
+           "fleet_factorizations_per_cold_key": 1.0,
+           # typed statuses are exception class names (uppercase) or
+           # the ok/degraded outcomes — "TenantThrottled" is a shed
+           # doing its job, not an escape
+           "by_status": {"ok": 90, "TenantThrottled": 10},
+           "gate": {"passed": True}}
+    rec.update(over)
+    return rec
+
+
+def _day_root(tmp_path, rec):
+    root = _copy_repo_records(tmp_path, include=("BASELINES.json",))
+    doc = json.load(open(tmp_path / "BASELINES.json"))
+    doc["platforms"].setdefault("cpu", {}).setdefault("fleet_day", {})
+    (tmp_path / "BASELINES.json").write_text(json.dumps(doc))
+    _append(tmp_path, "FLEET_DAY.jsonl", rec)
+    return root
+
+
+def test_fleet_day_green_record_passes(tmp_path):
+    root = _day_root(tmp_path, _day_record())
+    findings, passed = regress.check_repo(root)
+    day = [f for f in findings if f["check"] == "fleet_day"]
+    assert day and all(f["status"] == "ok" for f in day)
+    assert passed
+
+
+@pytest.mark.parametrize("bad,metric", [
+    ({"lost": 1}, "lost"),
+    ({"hung": 2}, "hung"),
+    ({"unaccounted": 1}, "unaccounted"),
+    ({"takeover_factorizations": 3}, "takeover_factorizations"),
+    ({"fleet_factorizations_per_cold_key": 1.25},
+     "fleet_factorizations_per_cold_key"),
+    # 0.75 is just as broken: a "cold" key that never factored means
+    # the ledger (or the drill) lied
+    ({"fleet_factorizations_per_cold_key": 0.75},
+     "fleet_factorizations_per_cold_key"),
+    ({"gate": {"passed": False}}, "gate.passed"),
+])
+def test_fleet_day_regressions_are_red(tmp_path, bad, metric):
+    root = _day_root(tmp_path, _day_record(**bad))
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    failed = {f["metric"] for f in findings
+              if f["status"] == "fail" and f["check"] == "fleet_day"}
+    assert failed == {metric}
+    assert regress.main(["--root", root]) == 1
+
+
+def test_fleet_day_untyped_status_is_red(tmp_path):
+    # a lowercase non-outcome status is a failure that escaped the
+    # typed taxonomy — the structural all-typed pin
+    root = _day_root(tmp_path, _day_record(
+        by_status={"ok": 90, "error": 2}))
+    findings, passed = regress.check_repo(root)
+    assert not passed
+    (f,) = [f for f in findings if f["status"] == "fail"]
+    assert f["check"] == "fleet_day" and f["metric"] == "untyped"
+    assert f["value"] == 2
+
+
+def test_fleet_day_update_adopts_structural_baseline(tmp_path):
+    root = str(tmp_path)
+    _append(tmp_path, "FLEET_DAY.jsonl", _day_record())
+    assert regress.main(["--root", root, "--update"]) == 0
+    doc = json.load(open(tmp_path / "BASELINES.json"))
+    # structural zero-gates only: the baseline entry is EMPTY, its
+    # presence is what arms the check
+    assert doc["platforms"]["cpu"]["fleet_day"] == {}
+    assert regress.main(["--root", root]) == 0
+
+
+# --------------------------------------------------------------------
 # the re-baseline workflow
 # --------------------------------------------------------------------
 

@@ -311,6 +311,67 @@ def test_breaker_leaked_probe_self_releases():
     assert br.allow("k"), "leaked probe permanently broke the key"
 
 
+def test_breaker_defaults_route_through_flags(monkeypatch):
+    """SLU_BREAKER_THRESHOLD / SLU_BREAKER_COOLDOWN_S set the fleet-
+    wide constructor defaults; explicit arguments still win."""
+    monkeypatch.setenv("SLU_BREAKER_THRESHOLD", "7")
+    monkeypatch.setenv("SLU_BREAKER_COOLDOWN_S", "2.5")
+    br = CircuitBreaker()
+    assert br.threshold == 7
+    assert br.cooldown_s == 2.5
+    br = CircuitBreaker(threshold=1, cooldown_s=60.0)
+    assert br.threshold == 1 and br.cooldown_s == 60.0
+    monkeypatch.delenv("SLU_BREAKER_THRESHOLD")
+    monkeypatch.delenv("SLU_BREAKER_COOLDOWN_S")
+    br = CircuitBreaker()
+    assert br.threshold == 3 and br.cooldown_s == 30.0
+
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """N threads hammer allow() the instant the cooldown elapses: the
+    half-open state must admit exactly ONE probe — a thundering herd
+    on a just-cooled key is precisely what half-open exists to stop."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                        clock=lambda: t[0])
+    br.record_failure("k")
+    assert br.state("k") == "open"
+    t[0] = 6.0
+    admitted = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        if br.allow("k"):
+            admitted.append(1)
+
+    ts = [threading.Thread(target=race) for _ in range(8)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert len(admitted) == 1
+    assert br.state("k") == "half_open"
+    # the probe reports success: the circuit closes for everyone
+    br.record_success("k")
+    assert all(br.allow("k") for _ in range(8))
+
+
+def test_breaker_snapshot_counts_by_state():
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                        clock=lambda: t[0])
+    assert br.snapshot() == {"tracked": 0, "by_state": {}}
+    br.record_failure("a")                  # open
+    br.record_failure("b")                  # open
+    br.allow("c")                           # untracked: closed
+    t[0] = 6.0
+    assert br.allow("a")                    # half-open probe
+    snap = br.snapshot()
+    assert snap["tracked"] == 2
+    assert snap["by_state"] == {"open": 1, "half_open": 1}
+
+
 def test_store_hit_closes_open_circuit(tmp_path):
     """The half-open probe resolving via the store read-through is a
     SUCCESS: the circuit closes instead of leaking the probe."""

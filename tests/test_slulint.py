@@ -369,3 +369,105 @@ def test_fingerprints_are_line_stable():
     f1 = Finding("r", "p.py", 10, "msg", detail="sym")
     f2 = Finding("r", "p.py", 99, "msg", detail="sym")
     assert f1.fingerprint == f2.fingerprint
+
+
+# -- the ServeError taxonomy audit ------------------------------------
+
+def _taxonomy_tree(tmp_path, errors_src, loadgen_src, service_src):
+    serve = tmp_path / "superlu_dist_tpu" / "serve"
+    serve.mkdir(parents=True)
+    (serve / "errors.py").write_text(errors_src)
+    (serve / "loadgen.py").write_text(loadgen_src)
+    (serve / "service.py").write_text(service_src)
+    return str(tmp_path)
+
+
+_TAX_ERRORS = '''
+class ServeError(Exception):
+    pass
+
+class ServeRejected(ServeError):
+    pass
+
+class TenantThrottled(ServeRejected):
+    pass
+
+class Orphaned(ServeError):
+    pass
+'''
+
+_TAX_LOADGEN = '''
+from .errors import Orphaned, ServeError, ServeRejected, \\
+    TenantThrottled
+
+def _status_of_solve(do_solve):
+    try:
+        return do_solve(), None
+    except TenantThrottled:
+        return "shed", None
+    except ServeRejected:
+        return "rejected", None
+    except Orphaned:
+        return "orphaned", None
+    except ServeError:
+        return "serve_error", None
+'''
+
+_TAX_SERVICE = '''
+from .errors import Orphaned, ServeError, ServeRejected, \\
+    TenantThrottled
+
+def _outcome_of(e):
+    for cls, name in ((TenantThrottled, "shed"),
+                      (ServeRejected, "rejected"),
+                      (Orphaned, "orphaned"),
+                      (ServeError, "serve_error")):
+        if isinstance(e, cls):
+            return name
+    return "ok"
+'''
+
+
+def test_taxonomy_audit_green_on_head():
+    """Every ServeError subclass on HEAD is named in BOTH status
+    ledgers — the pin that makes 'new error class, forgot the
+    ledger' a lint failure instead of silent serve_error drift."""
+    from tools.slulint.rules.taxonomy import taxonomy_audit
+    assert taxonomy_audit(ROOT) == []
+
+
+def test_taxonomy_audit_green_on_fully_mapped_tree(tmp_path):
+    from tools.slulint.rules.taxonomy import taxonomy_audit
+    root = _taxonomy_tree(tmp_path, _TAX_ERRORS, _TAX_LOADGEN,
+                          _TAX_SERVICE)
+    assert taxonomy_audit(root) == []
+
+
+def test_taxonomy_audit_red_on_unmapped_subclass(tmp_path):
+    """Dropping one subclass from one ledger yields exactly one
+    finding naming the class, the ledger, and the subclass's line in
+    errors.py — transitive subclasses (TenantThrottled under
+    ServeRejected) are still covered."""
+    from tools.slulint.rules.taxonomy import taxonomy_audit
+    lg = _TAX_LOADGEN.replace("    except Orphaned:\n"
+                              "        return \"orphaned\", None\n",
+                              "")
+    root = _taxonomy_tree(tmp_path, _TAX_ERRORS, lg, _TAX_SERVICE)
+    fs = taxonomy_audit(root)
+    assert len(fs) == 1
+    (f,) = fs
+    assert f.rule == "untyped-status"
+    assert "Orphaned" in f.msg and "_status_of_solve" in f.msg
+    assert f.path == "superlu_dist_tpu/serve/errors.py"
+    assert f.line > 0
+    # the fingerprint detail is class+ledger: a rename shows up as a
+    # NEW finding, not a silently-matching baseline entry
+    assert f.detail == "Orphaned:_status_of_solve"
+
+
+def test_taxonomy_audit_red_on_missing_ledger(tmp_path):
+    from tools.slulint.rules.taxonomy import taxonomy_audit
+    root = _taxonomy_tree(tmp_path, _TAX_ERRORS, "x = 1\n",
+                          _TAX_SERVICE)
+    fs = taxonomy_audit(root)
+    assert any("not found" in f.msg for f in fs)

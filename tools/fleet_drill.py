@@ -33,6 +33,16 @@ tools/regress.py gates the committed history.  Wire-up:
 `python -m tools.fleet_drill`, `python bench.py --fleet`, or the
 tpu_fire.sh fleet step.  Knobs: SLU_FLEET_REPLICAS / SLU_FLEET_K /
 SLU_FLEET_REQUESTS / SLU_FLEET_KILL_AFTER / SLU_FLEET_TTL_S.
+
+`--day` runs the DAY-IN-THE-LIFE drill instead (ISSUE 16): the
+elastic fleet controller (superlu_dist_tpu/fleet/controller.py)
+driving popularity-based prefactor, SLO-burn-triggered weighted shed
++ autoscale with ring-arc handoff, rolling restarts, and one SIGKILL
+— gated on zero lost requests, every shed typed, one factorization
+per cold key across the whole day, zero takeover factorizations and
+bounded per-phase p99; appended to SLU_FLEET_DAY_OUT (default
+FLEET_DAY.jsonl).  Knobs: SLU_FLEET_DAY_REQUESTS /
+SLU_FLEET_DAY_P99_MS.
 """
 
 from __future__ import annotations
@@ -76,15 +86,18 @@ def run_replica(name: str, socket_path: str, store_dir: str,
 
     from superlu_dist_tpu import Options
     from superlu_dist_tpu.fleet.lease import FleetCoordinator
+    from superlu_dist_tpu.fleet.policy import QosGate
     from superlu_dist_tpu.models.gssvx import factorize
-    from superlu_dist_tpu.obs import flight
+    from superlu_dist_tpu.obs import flight, slo
     from superlu_dist_tpu.resilience import chaos
+    from superlu_dist_tpu.resilience.breaker import CircuitBreaker
     from superlu_dist_tpu.resilience.store import FactorStore
     from superlu_dist_tpu.serve import (DegradedResult, FactorCache,
                                         ServeConfig, ServeError,
-                                        SolveService)
+                                        SolveService, matrix_key)
 
     flight.configure()          # adopt SLU_FLIGHT_JSONL from the env
+    slo.configure()             # adopt SLU_SLO (day drill sets it)
     mats = _drill_matrices(k, n_keys)
     opts = Options(factor_dtype="float64")
 
@@ -99,15 +112,19 @@ def run_replica(name: str, socket_path: str, store_dir: str,
         return factorize(a, options, plan=plan, backend="host")
 
     store = FactorStore(store_dir)
+    qos = QosGate()             # fractions set over the wire ("shed")
+    coord = FleetCoordinator(store_dir, ttl_s=ttl_s, poll_s=0.02)
     svc = SolveService(ServeConfig(
         max_queue_depth=1024, backend="host", degraded=True,
         factor_retries=1, retry_base_s=0.01,
-        breaker_threshold=3, breaker_cooldown_s=1.0, fleet=False),
+        breaker_threshold=3, breaker_cooldown_s=1.0, fleet=False,
+        qos=qos),
         cache=FactorCache(
-            backend="host", store=store,
-            fleet=FleetCoordinator(store_dir, ttl_s=ttl_s,
-                                   poll_s=0.02),
+            backend="host", store=store, fleet=coord,
+            breaker=CircuitBreaker(threshold=3, cooldown_s=1.0),
             factorize_fn=slow_factorize))
+    keys = [matrix_key(m, opts) for m in mats]
+    key_index = {kk: i for i, kk in enumerate(keys)}
 
     def handle(conn) -> None:
         rng_cache: dict = {}
@@ -123,16 +140,22 @@ def run_replica(name: str, socket_path: str, store_dir: str,
                                "replica": flight.replica_id()})
                 elif cmd == "solve":
                     i = int(msg["key_i"])
-                    a = mats[i]
+                    # by_key: a KEYED submit — under the day drill's
+                    # trickle this fails typed (FactorMissError) on
+                    # cold keys while still seeding the cache's
+                    # demand ledger, so the controller's prefactor is
+                    # the thing that actually warms the fleet
+                    a = keys[i] if msg.get("by_key") else mats[i]
                     seed = int(msg.get("seed", 0))
                     rng = rng_cache.setdefault(
                         seed, np.random.default_rng(seed))
-                    b = rng.standard_normal(a.n)
+                    b = rng.standard_normal(mats[i].n)
                     info: dict = {}
                     try:
                         x = svc.solve(a, b, options=opts,
                                       deadline_s=msg.get("deadline_s"),
-                                      info=info)
+                                      info=info,
+                                      tenant=msg.get("tenant"))
                         status = ("nonfinite"
                                   if not np.all(np.isfinite(x))
                                   else "degraded"
@@ -143,12 +166,58 @@ def run_replica(name: str, socket_path: str, store_dir: str,
                     conn.send({"status": status,
                                "rid": info.get("request_id"),
                                "replica": flight.replica_id()})
+                elif cmd == "prefactor":
+                    # the controller's warm path: runs the fleet
+                    # single-flight, so a concurrent prefactor of the
+                    # same key elsewhere still factors ONCE pool-wide
+                    i = int(msg["key_i"])
+                    try:
+                        svc.prefactor(mats[i], opts)
+                        conn.send({"ok": True})
+                    except Exception as e:  # noqa: BLE001 — typed
+                        conn.send({"ok": False,         # to driver
+                                   "status": type(e).__name__})
+                elif cmd == "shed":
+                    qos.set_fractions(dict(msg.get("fractions") or {}))
+                    conn.send({"ok": True})
+                elif cmd == "drain":
+                    # retire protocol step (fleet/scaler.py): release
+                    # every held lease so successors never wait out
+                    # this replica's TTL
+                    coord.release_all()
+                    conn.send({"ok": True})
                 elif cmd == "stats":
                     st = svc.cache.stats()
+                    burn = 0.0
+                    if slo.enabled():
+                        for sk, rec_ in slo.snapshot()["keys"].items():
+                            # "unrouted" holds front-door refusals —
+                            # including this replica's OWN QoS sheds —
+                            # and never sees ok traffic: feeding it
+                            # back would latch the shed forever
+                            # (fleet/controller.signals_from skips it
+                            # for the same reason)
+                            if sk == "unrouted":
+                                continue
+                            burn = max(
+                                burn,
+                                float(rec_["burn_rate_availability"]),
+                                float(rec_["burn_rate_latency"]))
+                    pop = [{"key_i": key_index[e["key"]],
+                            "count": e["count"],
+                            "resident": e["resident"]}
+                           for e in svc.cache.popularity()
+                           if e["key"] in key_index]
                     conn.send({
                         "replica": flight.replica_id(),
                         "pid": os.getpid(),
                         "cache": st,
+                        "burn": burn,
+                        "popularity": pop,
+                        "qos": qos.snapshot(),
+                        "breaker": (svc.cache.breaker.snapshot()
+                                    if svc.cache.breaker is not None
+                                    else {}),
                         "flight": {
                             k_: v for k_, v in
                             flight.snapshot().items()
@@ -554,6 +623,575 @@ def _check_fleet_trace(flight_log: str) -> dict:
     return out
 
 
+# --------------------------------------------------------------------
+# day-in-the-life drill (ISSUE 16): the elastic fleet controller
+# --------------------------------------------------------------------
+
+class _FactLedger:
+    """Cumulative factorization accounting across replica GENERATIONS:
+    `last_seen` tracks each live process's counter at its most recent
+    stats poll; a process that exits (close, retire, kill) has its
+    last-seen count BANKED so restarts — whose counters reset to 0 —
+    never make fleet-wide work disappear.  total() is therefore the
+    number of factorizations ever run by any process in the drill,
+    and total()/n_keys is the one-factorization-per-cold-key gate."""
+
+    def __init__(self) -> None:
+        self.last_seen: dict[str, int] = {}
+        self.banked = 0
+
+    def update(self, name: str, count: int) -> None:
+        self.last_seen[name] = int(count)
+
+    def bank(self, name: str) -> None:
+        self.banked += self.last_seen.pop(name, 0)
+
+    def total(self) -> int:
+        return self.banked + sum(self.last_seen.values())
+
+
+def run_day_drill(argv=()) -> dict:
+    """A day in the life of the elastic fleet, end to end:
+
+      trickle   — keyed solves fail typed on cold keys (failfast
+                  semantics of the keyed path) while seeding the
+                  demand ledger
+      prefactor — controller tick: popularity-driven Prefactor at
+                  each key's ring home; the ONLY factorizations of
+                  the whole day (one per key, fleet-wide)
+      morning   — ramped tenant-mixed load, ring-routed, all warm
+      flash     — flash crowd on the hot key + latency chaos at its
+                  home; the SLO burn trips the controller: weighted
+                  shed (batch drops, premium never) + scale-up with
+                  ring-arc handoff (the new replica adopts from the
+                  store)
+      rolling   — each original replica drained out of the ring,
+                  restarted, re-announced — under live load
+      evening   — load falls, the burn reads low again: shed lifts,
+                  the elastic replica is retired (drain → demote →
+                  release-leases → stop)
+      kill      — one original SIGKILL'd mid-load; survivors take
+                  over WARM (zero takeover factorizations)
+
+    Gates: zero lost / zero hung / all accounted, every non-ok
+    status typed, one factorization per cold key ACROSS THE WHOLE
+    DAY, zero takeover factorizations, shed exercised with premium
+    untouched, >=1 scale-up and >=1 retire, and bounded p99 through
+    every phase.  One line appended to SLU_FLEET_DAY_OUT
+    (FLEET_DAY.jsonl), gated by tools/regress.py.
+    """
+    import shutil
+    import tempfile
+
+    repo = _repo()
+    sys.path.insert(0, repo)
+    k = int(os.environ.get("SLU_FLEET_K", "4"))
+    per_phase = int(os.environ.get("SLU_FLEET_DAY_REQUESTS", "32"))
+    p99_cap_ms = float(os.environ.get("SLU_FLEET_DAY_P99_MS",
+                                      "10000"))
+    ttl_s = float(os.environ.get("SLU_FLEET_TTL_S") or 0.0) or 20.0
+    out_path = os.environ.get("SLU_FLEET_DAY_OUT",
+                              os.path.join(repo, "FLEET_DAY.jsonl"))
+    n_keys = 4
+    n_orig = 3
+    factor_delay_s = 0.5
+    workdir = tempfile.mkdtemp(prefix="slu_fleet_day_")
+    store_dir = os.path.join(workdir, "store")
+    members_dir = os.path.join(workdir, "members")
+    flight_log = os.path.join(workdir, "fleet_flight.jsonl")
+    os.makedirs(store_dir, exist_ok=True)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["SLU_FLIGHT_JSONL"] = flight_log
+    env["SLU_FLEET_TTL_S"] = str(ttl_s)
+    # tight p99 target + short window: the flash crowd's injected
+    # latency must show up as burn within one controller cadence
+    env["SLU_SLO"] = "p99_ms=20,avail=0.999,window_s=10"
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.fleet import (FleetController, FleetPolicy,
+                                        FleetSignals,
+                                        MembershipDirectory,
+                                        PolicyConfig, ReplicaScaler,
+                                        arc_moves)
+    from superlu_dist_tpu.fleet.pool import _route_key
+    from superlu_dist_tpu.fleet.router import HashRing
+    from superlu_dist_tpu.serve import matrix_key
+
+    mats = _drill_matrices(k, n_keys)
+    opts = Options(factor_dtype="float64")
+    keys = [matrix_key(m, opts) for m in mats]
+    route_keys = [_route_key(kk) for kk in keys]
+
+    names = [f"r{i}" for i in range(n_orig)]
+    all_names = names + [f"r{i}" for i in range(n_orig, n_orig + 4)]
+    sockets = {n: os.path.join(workdir, n + ".sock")
+               for n in all_names}
+    procs: dict = {}
+    down: set = set()
+    lock = threading.Lock()
+    client = _ReplicaClient(sockets, None, down, lock)
+    ledger = _FactLedger()
+    membership = MembershipDirectory(members_dir)
+    state = {"ring": None, "routes": [], "live": set(),
+             "arc_moves": 0, "ring_changes": 0}
+
+    def spawn_proc(name: str) -> None:
+        for p in (sockets[name], sockets[name] + ".ready"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "tools.fleet_drill",
+             "--replica", "--name", name, "--socket", sockets[name],
+             "--store", store_dir, "--k", str(k),
+             "--keys", str(n_keys),
+             "--factor-delay", str(factor_delay_s),
+             "--ttl", str(ttl_s)],
+            cwd=repo, env=env)
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(sockets[name] + ".ready"):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"replica {name} never came up")
+            time.sleep(0.1)
+        while client.request([name], {"cmd": "ping"}, 10.0,
+                             ignore_down=True) is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"replica {name} never answered")
+            time.sleep(0.2)
+        with lock:
+            down.discard(name)
+
+    def set_ring(members) -> None:
+        old = state["ring"]
+        state["ring"] = (old.with_replicas(members) if old is not None
+                         else HashRing(members))
+        state["routes"] = [state["ring"].route(rk)
+                           for rk in route_keys]
+        if old is not None:
+            moved = arc_moves(old, state["ring"], route_keys)
+            state["arc_moves"] += len(moved)
+            state["ring_changes"] += 1
+
+    def stop_proc(name: str) -> None:
+        """Graceful stop: bank the replica's factorization count,
+        close it over the wire, reap the process."""
+        s = client.request([name], {"cmd": "stats"}, 30.0,
+                           ignore_down=True)
+        if s is not None:
+            ledger.update(name, s["cache"]["factorizations"])
+        client.request([name], {"cmd": "close"}, 10.0,
+                       ignore_down=True)
+        p = procs.get(name)
+        if p is not None:
+            try:
+                p.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        ledger.bank(name)
+
+    # -- controller wiring: gather / actuator over the wire ----------
+
+    shed_table = {"fractions": {}}
+
+    def gather() -> FleetSignals:
+        burn = 0.0
+        pop: dict[int, list] = {}
+        breaker_by_state: dict[str, int] = {}
+        for n in sorted(state["live"]):
+            s = client.request([n], {"cmd": "stats"}, 30.0)
+            if s is None:
+                continue
+            ledger.update(n, s["cache"]["factorizations"])
+            burn = max(burn, float(s.get("burn", 0.0)))
+            for e in s.get("popularity", ()):
+                cur = pop.setdefault(int(e["key_i"]), [0, False])
+                cur[0] += int(e["count"])
+                cur[1] = cur[1] or bool(e["resident"])
+            for st_, c in (s.get("breaker") or {}).get(
+                    "by_state", {}).items():
+                breaker_by_state[st_] = \
+                    breaker_by_state.get(st_, 0) + int(c)
+        popularity = tuple(
+            {"key": i, "count": c, "resident": r,
+             "home": state["ring"].home(route_keys[i])}
+            for i, (c, r) in sorted(pop.items()))
+        return FleetSignals(burn=burn,
+                            replicas=tuple(sorted(state["live"])),
+                            popularity=popularity,
+                            breaker_by_state=breaker_by_state)
+
+    scaler = ReplicaScaler(
+        membership,
+        spawn_fn=spawn_proc,
+        drain_fn=lambda n: client.request(
+            [n], {"cmd": "drain"}, 30.0, ignore_down=True),
+        stop_fn=stop_proc)
+
+    class _DayActuator:
+        def __init__(self) -> None:
+            self.prefactor_results: list = []
+
+        def prefactor(self, act) -> None:
+            r = client.request([act.home],
+                               {"cmd": "prefactor",
+                                "key_i": int(act.key)},
+                               timeout_s=120.0)
+            self.prefactor_results.append(
+                {"key_i": int(act.key), "home": act.home,
+                 "ok": bool(r and r.get("ok"))})
+
+        def scale_up(self, act) -> None:
+            free = [n for n in all_names if n not in state["live"]
+                    and n not in down]
+            if not free:
+                raise RuntimeError("no replica slots left")
+            name = free[0]
+            print(f"# day: scale up {name} ({act.reason})",
+                  file=sys.stderr)
+            scaler.scale_up(name)
+            if shed_table["fractions"]:
+                # a replica joining mid-shed must enforce the same
+                # policy as its peers from its first request
+                client.request([name], {"cmd": "shed",
+                                        "fractions":
+                                        shed_table["fractions"]},
+                               30.0)
+            state["live"].add(name)
+            set_ring(sorted(state["live"]))
+
+        def retire(self, act) -> None:
+            print(f"# day: retire {act.replica} ({act.reason})",
+                  file=sys.stderr)
+            state["live"].discard(act.replica)
+            set_ring(sorted(state["live"]))
+            scaler.retire(act.replica)
+
+        def shed(self, act) -> None:
+            shed_table["fractions"] = dict(act.fractions)
+            for n in sorted(state["live"]):
+                client.request([n], {"cmd": "shed",
+                                     "fractions": act.fractions},
+                               30.0)
+
+    actuator = _DayActuator()
+    policy = FleetPolicy(PolicyConfig(
+        burn_high=2.0, burn_low=0.25, min_replicas=n_orig,
+        max_replicas=n_orig + 1, scale_cooldown_s=0.0,
+        prefactor_min=2,
+        tenant_weights={"premium": 1.0, "batch": 0.0}))
+    controller = FleetController(policy, gather, actuator)
+
+    # -- phase runner -------------------------------------------------
+
+    phases: list = []
+    all_statuses: list = []
+    shed_by_tenant: dict[str, int] = {}
+    hung_total = [0]
+
+    def load_phase(name: str, total: int, pick_key, pick_tenant,
+                   think_s: float, by_key: bool = False,
+                   n_workers: int = 4, on_served=None) -> dict:
+        statuses: list = []
+        lats: list = []
+        st_lock = threading.Lock()
+        served = [0]
+
+        def worker(wid: int, n_req: int) -> None:
+            import numpy as _np
+            rng = _np.random.default_rng(7000 + wid)
+            for j in range(n_req):
+                time.sleep(float(rng.exponential(think_s)))
+                ki = int(pick_key(rng))
+                tenant = pick_tenant(rng)
+                t0 = time.monotonic()
+                r = client.request(
+                    state["routes"][ki],
+                    {"cmd": "solve", "key_i": ki, "by_key": by_key,
+                     "seed": wid * 10000 + j, "tenant": tenant},
+                    timeout_s=60.0)
+                lat = time.monotonic() - t0
+                with st_lock:
+                    st = r["status"] if r else "lost"
+                    statuses.append(st)
+                    lats.append(lat)
+                    if st == "TenantThrottled":
+                        shed_by_tenant[tenant] = \
+                            shed_by_tenant.get(tenant, 0) + 1
+                    served[0] += 1
+                    n_served = served[0]
+                if on_served is not None:
+                    on_served(n_served)
+
+        n_workers = min(n_workers, total)
+        counts = [total // n_workers] * n_workers
+        for i in range(total % n_workers):
+            counts[i] += 1
+        ws = [threading.Thread(target=worker, args=(i, c),
+                               daemon=True)
+              for i, c in enumerate(counts)]
+        t0 = time.monotonic()
+        for w in ws:
+            w.start()
+        join_deadline = t0 + 300.0
+        for w in ws:
+            w.join(max(0.0, join_deadline - time.monotonic()))
+        hung = sum(1 for w in ws if w.is_alive())
+        hung_total[0] += hung
+        by_status: dict = {}
+        for s in statuses:
+            by_status[s] = by_status.get(s, 0) + 1
+        lats_ok = sorted(lats)
+        p99_ms = (lats_ok[min(len(lats_ok) - 1,
+                              int(round(0.99 * (len(lats_ok) - 1))))]
+                  * 1e3 if lats_ok else 0.0)
+        rec = {"phase": name, "requests": total,
+               "by_status": by_status,
+               "lost": by_status.get("lost", 0),
+               "unaccounted": total - len(statuses), "hung": hung,
+               "p99_ms": round(p99_ms, 1),
+               "wall_s": round(time.monotonic() - t0, 3)}
+        phases.append(rec)
+        all_statuses.extend(statuses)
+        print(f"# day: phase {name}: {by_status} "
+              f"p99={rec['p99_ms']}ms", file=sys.stderr)
+        return rec
+
+    report: dict = {"mode": "fleet_day", "replicas": n_orig,
+                    "max_replicas": n_orig + 1, "k": k,
+                    "keys": n_keys,
+                    "requests_per_phase": per_phase,
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        for n in names:
+            spawn_proc(n)
+            membership.announce(n, state="up")
+            state["live"].add(n)
+        set_ring(sorted(state["live"]))
+        print(f"# day: {n_orig} replicas up", file=sys.stderr)
+
+        # --- TRICKLE: keyed solves — typed misses seed the demand
+        # ledger at each key's home; nothing factors yet
+        def trickle_key(rng):
+            trickle_key.i = (getattr(trickle_key, "i", -1) + 1)
+            return trickle_key.i % n_keys
+
+        load_phase("trickle", 3 * n_keys, trickle_key,
+                   lambda rng: "premium", think_s=0.01, by_key=True,
+                   n_workers=1)
+        pre_tick_factorizations = \
+            (gather(), ledger.total())[1]   # gather refreshes ledger
+
+        # --- PREFACTOR: controller tick #1 — popularity-driven
+        # warming at ring homes, the only factorizations of the day
+        controller.tick()
+        gather()
+        report["prefactor"] = {
+            "pre_tick_factorizations": pre_tick_factorizations,
+            "actions": list(actuator.prefactor_results),
+            "post_tick_factorizations": ledger.total(),
+        }
+        print(f"# day: prefactor warmed {ledger.total()} keys "
+              f"(policy-driven)", file=sys.stderr)
+
+        # --- MORNING: ramped tenant-mixed warm load
+        load_phase("morning", per_phase,
+                   lambda rng: int(rng.integers(n_keys)),
+                   lambda rng: ("premium" if rng.random() < 0.5
+                                else "batch"),
+                   think_s=0.02)
+
+        # --- FLASH CROWD: hot key 0 + latency chaos at its home;
+        # the burn trips the controller into shed + scale-up
+        hot_home = state["ring"].home(route_keys[0])
+        client.request([hot_home],
+                       {"cmd": "chaos", "spec": "latency=1.0:0.05",
+                        "seed": 0}, 30.0)
+        load_phase("flash", per_phase,
+                   lambda rng: (0 if rng.random() < 0.8
+                                else int(rng.integers(n_keys))),
+                   lambda rng: ("premium" if rng.random() < 0.5
+                                else "batch"),
+                   think_s=0.01)
+        controller.tick()       # sees the burn: Shed + ScaleUp
+        report["flash_burn"] = controller.snapshot()["burn"]
+        load_phase("flash_shed", per_phase,
+                   lambda rng: (0 if rng.random() < 0.8
+                                else int(rng.integers(n_keys))),
+                   lambda rng: ("premium" if rng.random() < 0.5
+                                else "batch"),
+                   think_s=0.01)
+        client.request([hot_home], {"cmd": "chaos_off"}, 30.0,
+                       ignore_down=True)
+
+        # --- ROLLING RESTART: each original replica drained out of
+        # the ring, restarted, re-announced — under live load
+        for victim in names:
+            def bg_key(rng):
+                return int(rng.integers(n_keys))
+
+            bg_done = threading.Event()
+
+            def bg_load() -> None:
+                load_phase(f"rolling_{victim}", per_phase // 2,
+                           bg_key, lambda rng: "premium",
+                           think_s=0.05, n_workers=2)
+                bg_done.set()
+
+            membership.announce(victim, state="draining")
+            state["live"].discard(victim)
+            set_ring(sorted(state["live"]))
+            bg = threading.Thread(target=bg_load, daemon=True)
+            bg.start()
+            stop_proc(victim)
+            spawn_proc(victim)
+            membership.announce(victim, state="up")
+            state["live"].add(victim)
+            set_ring(sorted(state["live"]))
+            bg_done.wait(timeout=300.0)
+
+        # --- EVENING: load falls; the rolling restarts cleared the
+        # originals' flash-era SLO windows, so the burn reads low
+        # again — the controller lifts the shed and retires the
+        # elastic replica
+        load_phase("evening", per_phase // 2,
+                   lambda rng: int(rng.integers(n_keys)),
+                   lambda rng: "premium", think_s=0.2, n_workers=2)
+
+        def refresh_slo_windows() -> None:
+            # the burn signal is per-replica and an SLO window trims
+            # relative to its LAST observation — a replica whose ring
+            # arc holds none of the drill's keys (r3, never restarted)
+            # quiesces with its flash-era burn intact forever.  A real
+            # deployment's health-check/trickle traffic keeps every
+            # window current; model it: one direct full-matrix solve
+            # per live replica (store adoption, never a factorization)
+            for i, n in enumerate(sorted(state["live"])):
+                client.request(
+                    [n], {"cmd": "solve", "key_i": i % n_keys,
+                          "by_key": False, "seed": 31337 + i,
+                          "tenant": "premium"},
+                    timeout_s=60.0, ignore_down=True)
+
+        deadline = time.monotonic() + 60.0
+        while (gather().burn > policy.config.burn_low
+               and time.monotonic() < deadline):
+            refresh_slo_windows()
+            load_phase("evening_cooldown", 4,
+                       lambda rng: int(rng.integers(n_keys)),
+                       lambda rng: "premium", think_s=0.3,
+                       n_workers=1)
+        controller.tick()       # burn low: Shed({}) + Retire
+        report["controller"] = controller.snapshot()
+        report["members_after_retire"] = \
+            sorted(membership.ring_members())
+
+        # --- NIGHT KILL: SIGKILL one original mid-load; survivors
+        # take over WARM off the shared store — zero factorizations
+        kill_victim = next(n for n in state["routes"][1]
+                           if n in names)
+        gather()                # last-seen counts BEFORE the kill
+        total_before_kill = ledger.total()
+        killed = [False]
+
+        def maybe_kill(n_served: int) -> None:
+            if n_served >= per_phase // 3 and not killed[0]:
+                killed[0] = True
+                print(f"# day: kill -9 {kill_victim} "
+                      f"(pid {procs[kill_victim].pid})",
+                      file=sys.stderr)
+                client.request([kill_victim],
+                               {"cmd": "die", "delay": 0.0}, 10.0,
+                               ignore_down=True)
+                time.sleep(0.3)
+                if procs[kill_victim].poll() is None:
+                    import signal as _sig
+                    os.kill(procs[kill_victim].pid, _sig.SIGKILL)
+
+        load_phase("kill", per_phase,
+                   lambda rng: int(rng.integers(n_keys)),
+                   lambda rng: "premium", think_s=0.02,
+                   on_served=maybe_kill)
+        state["live"].discard(kill_victim)
+        membership.remove(kill_victim)      # reap the dead member
+        set_ring(sorted(state["live"]))
+        gather()
+        report["takeover_factorizations"] = \
+            ledger.total() - total_before_kill
+        report["kill_victim"] = kill_victim
+
+        for n in sorted(state["live"]):
+            stop_proc(n)
+            membership.remove(n)
+        state["live"].clear()
+    finally:
+        for n, p in procs.items():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    by_status: dict = {}
+    for s in all_statuses:
+        by_status[s] = by_status.get(s, 0) + 1
+    untyped = sum(v for s, v in by_status.items()
+                  if s not in ("ok", "degraded") and s != "lost"
+                  and not s[:1].isupper())
+    total_requests = sum(p["requests"] for p in phases)
+    ratio = ledger.total() / n_keys
+    ctl = report.get("controller", {})
+    acts = ctl.get("actions", {})
+    pre = report.get("prefactor", {})
+    report.update({
+        "phases": phases,
+        "by_status": by_status,
+        "shed_by_tenant": dict(shed_by_tenant),
+        "requests_total": total_requests,
+        "lost": by_status.get("lost", 0),
+        "unaccounted": sum(p["unaccounted"] for p in phases),
+        "hung": hung_total[0],
+        "route_failovers": client.failovers,
+        "arc_moves": state["arc_moves"],
+        "ring_changes": state["ring_changes"],
+        "fleet_factorizations_per_cold_key": ratio,
+        "platform": env.get("JAX_PLATFORMS", "cpu").split(",")[0],
+    })
+    worst_p99 = max((p["p99_ms"] for p in phases), default=0.0)
+    report["worst_phase_p99_ms"] = worst_p99
+    report["gate"] = {
+        "zero_lost": report["lost"] == 0,
+        "zero_hung": report["hung"] == 0,
+        "all_accounted": report["unaccounted"] == 0,
+        "all_typed": untyped == 0,
+        "policy_prefactor":
+            pre.get("pre_tick_factorizations") == 0
+            and len(pre.get("actions", ())) == n_keys
+            and all(a["ok"] for a in pre.get("actions", ())),
+        "one_factorization_per_cold_key": ratio == 1.0,
+        "warm_takeover":
+            report.get("takeover_factorizations") == 0,
+        "shed_exercised":
+            shed_by_tenant.get("batch", 0) > 0
+            and shed_by_tenant.get("premium", 0) == 0,
+        "scaled": acts.get("scale_up", 0) >= 1
+        and acts.get("retire", 0) >= 1,
+        "p99_bounded": worst_p99 <= p99_cap_ms,
+    }
+    report["gate"]["passed"] = all(report["gate"].values())
+
+    line = json.dumps(report)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    if not report["gate"]["passed"]:
+        print(f"# FLEET DAY GATE FAILED: {report['gate']}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return report
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--replica" in argv:
@@ -569,7 +1207,10 @@ def main() -> None:
                     ttl_s=float(opt("--ttl", "20")))
         return
     repo = _repo()
-    run_drill(argv)
+    if "--day" in argv:
+        run_day_drill(argv)
+    else:
+        run_drill(argv)
     if os.environ.get("SLU_REGRESS", "1") != "0":
         sys.path.insert(0, repo)
         from tools import regress

@@ -28,6 +28,13 @@ tolerance:
                  fleet_factorizations_per_cold_key == 1,
                  takeover_factorizations == 0, gate.passed
                  (the multi-process drill record, FLEET.jsonl)
+  * fleet_day  — the day-in-the-life drill (fleet_drill --day):
+                 lost == 0, hung == 0, unaccounted == 0,
+                 untyped == 0 (every shed typed),
+                 fleet_factorizations_per_cold_key == 1 (policy
+                 prefactor rides the lease single-flight),
+                 takeover_factorizations == 0, gate.passed
+                 (FLEET_DAY.jsonl)
   * stream     — drift drill (serve_bench --stream): lost == 0,
                  hung == 0, unresolved == 0, guard_breaches == 0
                  (no result ever served past the berr guard),
@@ -195,6 +202,9 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "FLEET.jsonl")):
         if rec.get("mode") == "fleet":
             add(rec.get("platform"), "fleet", rec)
+    for rec in _read_jsonl(os.path.join(root, "FLEET_DAY.jsonl")):
+        if rec.get("mode") == "fleet_day":
+            add(rec.get("platform"), "fleet_day", rec)
     for rec in _read_jsonl(os.path.join(root, "GAUNTLET.jsonl")):
         if rec.get("mode") == "gauntlet":
             add(rec.get("platform"), "gauntlet", rec)
@@ -411,6 +421,51 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the fleet drill gate itself "
                     "failed"))
+            elif chk == "fleet_day":
+                zero_check(p, chk, "lost", _num(latest, "lost"),
+                           "a request was lost during the day drill "
+                           "(no replica produced an outcome through "
+                           "a transition)")
+                zero_check(p, chk, "hung", _num(latest, "hung"),
+                           "a day-drill worker hung")
+                zero_check(p, chk, "unaccounted",
+                           _num(latest, "unaccounted"),
+                           "a day-drill worker died with requests "
+                           "unaccounted for")
+                zero_check(p, chk, "takeover_factorizations",
+                           _num(latest, "takeover_factorizations"),
+                           "a survivor re-factored a published key "
+                           "after the kill instead of adopting it "
+                           "warm")
+                by = latest.get("by_status", {})
+                untyped = sum(
+                    v for s, v in by.items()
+                    if s not in ("ok", "degraded") and s != "lost"
+                    and not s[:1].isupper())
+                zero_check(p, chk, "untyped", float(untyped),
+                           "a day-drill failure escaped the typed "
+                           "taxonomy (an unshed, unexplained status)")
+                v = _num(latest, "fleet_factorizations_per_cold_key")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "fleet_factorizations_per_cold_key",
+                        None, 1.0, 1.0, "skip", "metric absent"))
+                else:
+                    ok = v == 1.0
+                    findings.append(_finding(
+                        p, chk, "fleet_factorizations_per_cold_key",
+                        v, 1.0, 1.0, "ok" if ok else "fail",
+                        "" if ok else "across the whole day — "
+                        "prefactor, flash crowd, restarts, kill — a "
+                        "cold key factored more (or less) than "
+                        "exactly once"))
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the day-in-the-life gate itself "
+                    "failed"))
             elif chk == "stream":
                 for m, why in (
                         ("lost", "a drill request was lost across "
@@ -530,6 +585,8 @@ def build_baselines(history: dict, tolerances: dict | None = None,
             elif chk == "chaos":
                 dst[chk] = {}
             elif chk == "fleet":
+                dst[chk] = {}          # structural zero-gates only
+            elif chk == "fleet_day":
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "stream":
                 dst[chk] = {}          # structural zero-gates only

@@ -96,6 +96,8 @@ def main(argv=None) -> int:
                 [(a, r) for a, r in pairs if locks.in_audit_scope(r)]))
             from .rules.envreads import flag_audit
             findings.extend(flag_audit(root))
+            from .rules.taxonomy import taxonomy_audit
+            findings.extend(taxonomy_audit(root))
             ruff_findings, ran = _run_ruff(root)
             findings.extend(ruff_findings)
         if not no_contracts:

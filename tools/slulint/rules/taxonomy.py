@@ -1,0 +1,120 @@
+"""`untyped-status`: the ServeError taxonomy must stay fully mapped.
+
+The serving story leans hard on TYPED failure: every way a request
+can fail is its own ServeError subclass, and both status ledgers —
+the load generator's `_status_of_solve` except-chain and the
+service's `_outcome_of` mapping — give each subclass its own status
+bucket.  A new subclass that someone forgets to map silently falls
+into the blanket `ServeError` handler and reads as "serve_error" in
+every drill and SLO window: the failure is still typed at the raise
+site but UNTYPED everywhere it is counted, which is exactly the
+drift the drills' all-typed gates cannot see (they check the status
+STRINGS, not the class list).  This audit closes the loop: it
+AST-parses serve/errors.py for the transitive ServeError subclass
+tree and demands each class appear by name in BOTH ledgers.
+
+Deliberately exempt: `ServeError` itself (the blanket handlers ARE
+its mapping) and classes whose mapping is inherited on purpose would
+still be flagged — a subclass that WANTS its parent's bucket must be
+named in the ledgers anyway, because "on purpose" is precisely the
+decision this audit forces someone to write down.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .. import Finding
+
+RULE = "untyped-status"
+
+
+def _serve_error_tree(errors_path: str) -> dict[str, int]:
+    """name -> lineno for every class in serve/errors.py that
+    transitively derives from ServeError (excluding ServeError)."""
+    with open(errors_path) as f:
+        tree = ast.parse(f.read())
+    bases: dict[str, list[str]] = {}
+    linenos: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases[node.name] = [b.id for b in node.bases
+                                if isinstance(b, ast.Name)]
+            linenos[node.name] = node.lineno
+    out: dict[str, int] = {}
+
+    def derives(name: str, seen=()) -> bool:
+        if name in seen:
+            return False
+        for b in bases.get(name, ()):
+            if b == "ServeError" or derives(b, seen + (name,)):
+                return True
+        return False
+
+    for name in bases:
+        if name != "ServeError" and derives(name):
+            out[name] = linenos[name]
+    return out
+
+
+def _function(tree: ast.AST, name: str) -> ast.FunctionDef | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _handled_exceptions(fn: ast.FunctionDef) -> set[str]:
+    """Class names appearing in the function's `except` clauses."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.ExceptHandler) \
+                and node.type is not None:
+            out |= _names_in(node.type)
+    return out
+
+
+def taxonomy_audit(root: str) -> list[Finding]:
+    """Every ServeError subclass must be named in BOTH status
+    ledgers: serve/loadgen.py `_status_of_solve` (an except clause)
+    and serve/service.py `_outcome_of` (an entry in its mapping)."""
+    serve = os.path.join(root, "superlu_dist_tpu", "serve")
+    errors_path = os.path.join(serve, "errors.py")
+    subclasses = _serve_error_tree(errors_path)
+
+    ledgers = []
+    for fname, funcname, extract in (
+            ("loadgen.py", "_status_of_solve", _handled_exceptions),
+            ("service.py", "_outcome_of", _names_in)):
+        path = os.path.join(serve, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        fn = _function(tree, funcname)
+        ledgers.append((fname, funcname,
+                        extract(fn) if fn is not None else None))
+
+    out: list[Finding] = []
+    for fname, funcname, names in ledgers:
+        rp = f"superlu_dist_tpu/serve/{fname}"
+        if names is None:
+            out.append(Finding(
+                RULE, rp, 0,
+                f"status ledger {funcname}() not found — the "
+                "taxonomy audit has nothing to check against",
+                detail=funcname))
+            continue
+        for cls, lineno in sorted(subclasses.items()):
+            if cls not in names:
+                out.append(Finding(
+                    RULE, "superlu_dist_tpu/serve/errors.py", lineno,
+                    f"ServeError subclass {cls} is not mapped in "
+                    f"{rp}::{funcname} — it would be counted as the "
+                    "blanket serve_error bucket, untyped in every "
+                    "drill ledger",
+                    detail=f"{cls}:{funcname}"))
+    return out
