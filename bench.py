@@ -1016,6 +1016,224 @@ def _gauntlet():
             f.write(json.dumps(rec) + "\n")
 
 
+def _multichip_serve():
+    """`bench.py --multichip-serve`: the mesh-resident serving A/B
+    (ISSUE 17).
+
+    Provisions a device mesh (the local accelerator complement, or a
+    set_cpu_devices(8) host mesh on the CPU rehearsal box), builds TWO
+    SolveServices over the SAME key set — one single-device, one
+    mesh-resident (ServeConfig.mesh) — and drives the identical
+    concurrent load through each arm's micro-batcher bucket ladder:
+    same matrices, same moment, same box, SLU_TRISOLVE=merged for both
+    (the row-partitioned merged mesh trisolve is the arm under test,
+    and the bit-match oracle models exactly that layout).
+
+    The record is ONE JSON object (the MULTICHIP_r* convention) at
+    SLU_MULTICHIP_OUT (default MULTICHIP_r06.json): per-arm throughput
+    and p99, the recompile pin (obs compile counter + jit cache growth,
+    both), the serve-path-vs-mesh_oracle_solve bitwise verdict, and
+    measure_comm's per-boundary collective-byte stamps.
+    tools/regress.py gates mode="multichip_serve" records (check
+    `multichip`): recompiles == 0, bitwise == True, solves/s floor and
+    p99 ceiling vs the BASELINES.json median.
+
+    Promote discipline (the --factor-ab convention): a failed gate
+    stamps the record measurement_invalid, persists NOTHING, and exits
+    1 — tpu_fire.sh discards the round."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import (cache_dir_for,
+                                              ensure_portable_cpu_isa)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import jax
+
+    from superlu_dist_tpu.utils.compat import set_cpu_devices
+
+    # the CPU rehearsal box exposes one device; provision a host mesh
+    # BEFORE backend init (a no-op when a real multichip complement or
+    # a test-env XLA_FLAGS already provides devices)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        set_cpu_devices(8)
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir_for(
+            os.path.join(repo, ".jax_cache"), accel=on_accel))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1)
+    except Exception:
+        pass
+    if on_accel:
+        from superlu_dist_tpu.utils.platform import (
+            apply_accel_amalg_defaults)
+        apply_accel_amalg_defaults()
+
+    ndev_avail = len(jax.devices())
+    if ndev_avail < 2:
+        print(json.dumps({"mode": "multichip_serve", "skipped": True,
+                          "reason": f"{ndev_avail} device(s): no mesh "
+                          "to serve on"}))
+        return
+
+    from superlu_dist_tpu import Options, obs
+    from superlu_dist_tpu.parallel import factor_dist as fd
+    from superlu_dist_tpu.parallel.grid import make_solver_mesh
+    from superlu_dist_tpu.serve import (ServeConfig, SolveService,
+                                        run_load, solve_jit_cache_size)
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    shape = os.environ.get("SLU_MESH_SHAPE", "").strip()
+    dims = ([int(d) for d in shape.lower().split("x")] if shape
+            else [ndev_avail])
+    dims = (dims + [1, 1])[:3]
+    mesh = make_solver_mesh(*dims).mesh
+    n_devices = int(np.asarray(mesh.devices).size)
+    mesh_shape = "x".join(str(int(mesh.shape[a]))
+                          for a in mesh.axis_names)
+
+    k = int(os.environ.get("SLU_SERVE_K", "8"))
+    concurrency = int(os.environ.get("SLU_SERVE_CONCURRENCY", "16"))
+    requests = int(os.environ.get("SLU_SERVE_REQUESTS", "192"))
+    linger_s = float(os.environ.get("SLU_SERVE_LINGER_MS", "2")) / 1e3
+    # the SAME key set for both arms: distinct patterns so the load
+    # exercises routing + residency, not one resident handle
+    mats = [laplacian_3d(k), laplacian_3d(k - 1), laplacian_3d(k + 1)]
+    opts = Options(factor_dtype="float64")
+
+    prior_tsv = os.environ.get("SLU_TRISOLVE")
+    os.environ["SLU_TRISOLVE"] = "merged"
+
+    def run_arm(mesh_obj):
+        svc = SolveService(ServeConfig(
+            max_queue_depth=max(64, 4 * requests),
+            max_linger_s=linger_s, mesh=mesh_obj))
+        t0 = time.perf_counter()
+        keys = [svc.prefactor(a, opts) for a in mats]
+        warm_s = time.perf_counter() - t0
+        lus = [svc.cache.peek(kk) for kk in keys]
+        jit_before = [solve_jit_cache_size(lu) for lu in lus]
+        misses_before = obs.COMPILE_WATCH.misses()
+        report = run_load(svc, keys, requests=requests,
+                          concurrency=concurrency, hot_fraction=1.0,
+                          seed=0)
+        misses_after = obs.COMPILE_WATCH.misses()
+        jit_after = [solve_jit_cache_size(lu) for lu in lus]
+        growth = (sum(a - b for a, b in zip(jit_after, jit_before))
+                  if all(b >= 0 for b in jit_before) else None)
+        return svc, keys, lus, {
+            "backend": lus[0].backend,
+            "warmup_s": round(warm_s, 3),
+            "by_status": report["by_status"],
+            "solves_per_s": report["solves_per_s"],
+            "p50_ms": report.get("p50_ms"),
+            "p95_ms": report.get("p95_ms"),
+            "p99_ms": report.get("p99_ms"),
+            "recompiles_under_load": misses_after - misses_before,
+            "jit_cache_growth": growth,
+        }
+
+    try:
+        print(f"# multichip-serve: one-device arm, {len(mats)} keys "
+              f"(k={k}) ...", file=sys.stderr)
+        svc1, _, _, arm1 = run_arm(None)
+        svc1.close()
+        print(f"# multichip-serve: mesh arm ({mesh_shape}, "
+              f"{n_devices} devices) ...", file=sys.stderr)
+        svcm, keys_m, lus_m, armm = run_arm(mesh)
+
+        # serve-path bitwise pin against the sequential one-device
+        # oracle of the mesh layout: the full request path (keyed
+        # submit -> batcher -> dist_solve -> unscale) must reproduce
+        # mesh_oracle_solve's bits under the plan's row/col
+        # transforms.  The pin key serves with refinement OFF — the
+        # oracle models the raw trisolve, and refinement sweeps are
+        # float-contingent host arithmetic on top of it (the load
+        # arms above keep the default refined serving)
+        from superlu_dist_tpu.options import IterRefine
+        key_pin = svcm.prefactor(mats[0], opts.replace(
+            iter_refine=IterRefine.NOREFINE))
+        lu0 = svcm.cache.peek(key_pin)
+        dlu = lu0.device_lu
+        plan = lu0.plan
+        rng = np.random.default_rng(7)
+        b = rng.standard_normal(mats[0].n)
+        x_serve = np.asarray(svcm.solve(key_pin, b))
+        bf = np.zeros(mats[0].n, np.float64)
+        bf[plan.final_row] = b * plan.row_scale
+        xo = fd.mesh_oracle_solve(dlu, bf[:, None])[:, 0]
+        x_oracle = xo[plan.final_col] * plan.col_scale
+        bitwise = bool(np.array_equal(x_serve, x_oracle))
+
+        # collective inventory AFTER the timed windows (lowering
+        # reuses the plan's cached programs, but the compile probes
+        # must never sit inside a recompile-pin window)
+        comm = fd.measure_comm(dlu, nrhs=1)
+        svcm.close()
+    finally:
+        if prior_tsv is None:
+            os.environ.pop("SLU_TRISOLVE", None)
+        else:
+            os.environ["SLU_TRISOLVE"] = prior_tsv
+
+    ok_status = all(s == "ok" for s in armm["by_status"]) \
+        and all(s == "ok" for s in arm1["by_status"])
+    gate = {
+        "passed": bool(ok_status and bitwise
+                       and armm["recompiles_under_load"] == 0
+                       and armm["jit_cache_growth"] in (0, None)),
+        "all_ok": ok_status,
+        "bitwise_vs_mesh_oracle": bitwise,
+        "recompiles_under_load": armm["recompiles_under_load"],
+        "jit_cache_growth": armm["jit_cache_growth"],
+    }
+    rec = {
+        "mode": "multichip_serve",
+        "n_devices": n_devices,
+        "mesh_shape": mesh_shape,
+        "axis_names": ",".join(str(a) for a in mesh.axis_names),
+        "k": k, "keys": len(mats),
+        "requests": requests, "concurrency": concurrency,
+        "arms": {"one_device": arm1, "mesh": armm},
+        # top-level mesh-arm figures: what tools/regress.py floors
+        # and ceilings against the BASELINES.json median
+        "solves_per_s": armm["solves_per_s"],
+        "p99_ms": armm["p99_ms"],
+        "mesh_vs_one_device": round(
+            armm["solves_per_s"] / max(arm1["solves_per_s"], 1e-12),
+            3),
+        "recompiles_under_load": armm["recompiles_under_load"],
+        "jit_cache_growth": armm["jit_cache_growth"],
+        "bitwise_vs_mesh_oracle": bitwise,
+        "comm": comm["MESH"],
+        "comm_solve": comm["SOLVE"],
+        "comm_factor": comm["FACT"],
+        "gate": gate,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    if not gate["passed"]:
+        rec["measurement_invalid"] = True
+    print(json.dumps(rec, indent=1))
+    if not gate["passed"]:
+        print(f"# MULTICHIP SERVE GATE FAILURE (all_ok={ok_status} "
+              f"bitwise={bitwise} recompiles="
+              f"{armm['recompiles_under_load']} jit_growth="
+              f"{armm['jit_cache_growth']}); record not persisted",
+              file=sys.stderr)
+        raise SystemExit(1)
+    out_path = os.environ.get(
+        "SLU_MULTICHIP_OUT", os.path.join(repo, "MULTICHIP_r06.json"))
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, out_path)
+
+
 def main():
     # --trace PATH: export the run's phase spans + compile events as
     # a Chrome trace-event JSON (Perfetto-loadable) alongside the
@@ -1087,6 +1305,13 @@ def main():
         # gate = zero silent-wrong answers + zero untyped failures;
         # appends to GAUNTLET.jsonl, gated by tools/regress.py
         _gauntlet()
+        return
+    if "--multichip-serve" in sys.argv[1:]:
+        # mesh-resident serving A/B (ISSUE 17): one-device vs mesh
+        # replica on the same key set — throughput/p99, recompile pin,
+        # bitwise-vs-mesh-oracle, per-boundary collective bytes; ONE
+        # JSON object to MULTICHIP_r06.json, gated by tools/regress.py
+        _multichip_serve()
         return
     if "--factor-ab" in sys.argv[1:]:
         # staged factor-sweep A/B (ISSUE 12): per-group vs

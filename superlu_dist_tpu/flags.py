@@ -176,6 +176,11 @@ FLAGS: dict[str, str] = {
     "SLU_SERVE_OUT": "serve_bench output path (default SERVE_LATENCY.jsonl)",
     "SLU_SERVE_MIN_SPEEDUP": "serve_bench regression floor on batched-vs-sequential speedup (default 1.0 = never lose; timeshared-box noise)",
     "SLU_SERVE_MIXED": "1 = serve_bench mixed-dtype-traffic scenario: same matrix at two precision rungs (f64 native + f32/df64), alternating traffic, pinning ZERO recompiles across rungs on the obs compile counter",
+    # --- mesh-resident serving (serve/service.py, parallel/factor_dist.py, tools/, bench.py) ---
+    "SLU_SERVE_MESH": "1 = mesh-resident serving: ServeConfig.mesh defaults to a device mesh (SLU_MESH_SHAPE), the factor cache factors through the shard_map'd dist backend, every request key carries an Options.mesh_shape leg, and factor_cost_hint_s resolves the 'dist' cost arm.  Off (default) = single-device serving, one env read of overhead at ServeConfig construction and at cost-hint resolution",
+    "SLU_MESH_SHAPE": "mesh grid for SLU_SERVE_MESH=1 ('2x2x2', '8'; default: all local devices on one flat axis) — resolved once per ServeConfig construction, zero per-request overhead",
+    "SLU_FLEET_MESH": "fleet drill mesh-replica arm (tools/fleet_drill.py): device count each replica process provisions as a CPU mesh (compat.set_cpu_devices) and serves mesh-resident on; 0 (default) = single-device replicas.  All replicas share one shape so cache keys match pool-wide and store adoption/single-flight hold with a mesh leader",
+    "SLU_MULTICHIP_OUT": "bench.py --multichip-serve record path (default MULTICHIP_r06.json): the one-device vs mesh-replica serve A/B record (throughput, p99, recompile pin, bitwise-vs-mesh-oracle, per-boundary collective bytes), regress-gated; a failed gate stamps measurement_invalid and persists nothing",
 }
 
 # Tokens the registry test's grep will hit that are NOT env flags:

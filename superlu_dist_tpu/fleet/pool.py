@@ -65,15 +65,34 @@ def _route_key(key: CacheKey) -> str:
     return f"{key.pattern}|{key.options!r}"
 
 
+def _endpoint_capacity(endpoint) -> float:
+    """Throughput weight of a replica endpoint: the device count of
+    its mesh when it serves mesh-resident (ServeConfig.mesh), else
+    1.0.  Duck-typed so socket stubs (the drill's client endpoints)
+    default to single-chip weight unless the caller overrides."""
+    mesh = getattr(getattr(endpoint, "config", None), "mesh", None)
+    if mesh is None:
+        return 1.0
+    import numpy as np
+    return float(np.asarray(mesh.devices).size)
+
+
 class ReplicaPool:
     """Route-and-failover front over named replica endpoints."""
 
     def __init__(self, replicas: dict, vnodes: int | None = None,
-                 metrics=None) -> None:
+                 metrics=None, capacities: dict | None = None) -> None:
         if not replicas:
             raise ValueError("ReplicaPool needs at least one replica")
         self.replicas = dict(replicas)
-        self.ring = HashRing(self.replicas, vnodes=vnodes)
+        # a mesh replica is ONE ring member with an N-device capacity
+        # weight (router.py); explicit capacities win over the
+        # endpoint-derived default
+        caps = {name: _endpoint_capacity(ep)
+                for name, ep in self.replicas.items()}
+        caps.update(capacities or {})
+        self.ring = HashRing(self.replicas, vnodes=vnodes,
+                             capacities=caps)
         self._metrics = metrics
         self._lock = threading.Lock()
         self._down: set[str] = set()

@@ -23,6 +23,16 @@ chaos.py already uses for its seeded streams.
 `vnodes` virtual nodes per replica (SLU_FLEET_VNODES, default 64)
 smooth the arc sizes: at 3 replicas × 64 vnodes the max/min keyspace
 share imbalance stays within ~2× (pinned by tests/test_fleet.py).
+
+Capacity weighting (ISSUE 17).  A mesh replica — one SolveService
+fronting an N-device mesh — registers in the ring as ONE member, but
+it solves N× the single-chip throughput, so equal keyspace shares
+would leave it idle while single-device siblings saturate.
+`capacities` scales each replica's vnode count (capacity 4.0 ⇒ 4× the
+vnodes ⇒ ~4× the keyspace share), keeping routing a pure function of
+(members, capacities, key): every client computes the same weighted
+homes, and the Karger minimal-movement property is untouched —
+capacity changes move only the resized replica's arcs.
 """
 
 from __future__ import annotations
@@ -47,22 +57,31 @@ class HashRing:
     key) so every client, and every test, computes the same homes.
     """
 
-    def __init__(self, replicas, vnodes: int | None = None) -> None:
+    def __init__(self, replicas, vnodes: int | None = None,
+                 capacities: dict | None = None) -> None:
         self.replicas = tuple(sorted(set(replicas)))
         if not self.replicas:
             raise ValueError("HashRing needs at least one replica")
         self.vnodes = int(vnodes) if vnodes \
             else flags.env_int("SLU_FLEET_VNODES", 64)
+        # per-replica throughput weight: vnode-count multiplier (a
+        # 4-device mesh replica at capacity 4.0 owns ~4× the keyspace
+        # of a single-chip sibling); absent ⇒ 1.0
+        self.capacities = {str(r): float(c)
+                           for r, c in (capacities or {}).items()}
         points: list[tuple[int, str]] = []
         for r in self.replicas:
-            for v in range(self.vnodes):
+            nv = max(1, round(self.vnodes
+                              * self.capacities.get(r, 1.0)))
+            for v in range(nv):
                 points.append((_point(f"{r}#{v}"), r))
         points.sort()
         self._points = [p for p, _ in points]
         self._owners = [r for _, r in points]
 
     def with_replicas(self, replicas) -> "HashRing":
-        return HashRing(replicas, vnodes=self.vnodes)
+        return HashRing(replicas, vnodes=self.vnodes,
+                        capacities=self.capacities)
 
     def home(self, key: str) -> str:
         """The key's home replica (route(key)[0], without building

@@ -84,6 +84,7 @@ FACTOR_KEY_FIELDS = (
     "relax", "max_super", "amalg_tau", "amalg_cap",
     "factor_dtype",
     "width_buckets", "front_buckets", "autotune", "algo3d",
+    "mesh_shape",
 )
 # NOT in the key: symb_threads/nd_threads (parallelism of the planning
 # pass, bit-identical output — test_multiprocess_dist pins it) and
@@ -225,6 +226,17 @@ class Options:
     # 3D-algorithm analog: number of forest levels replicated over the
     # mesh's Z axis (options->Algo3d, SRC/superlu_defs.h:754)
     algo3d: YesNo = YesNo.NO
+    # Device-mesh residency (ISSUE 17): the shape of the mesh the
+    # factors are sharded over, or None for single-device/host
+    # factors.  A FACTOR_KEY_FIELDS member on purpose — mesh-resident
+    # and single-device factorizations of the same matrix are
+    # different objects (per-device flats vs one slab) and must never
+    # serve each other's requests, so the serve cache, the durable
+    # store (entry_name hashes repr(options)) and the fleet routing
+    # key (fleet/pool.py _route_key) all fork on this leg.  The serve
+    # layer stamps it from ServeConfig.mesh; standalone callers pass
+    # grid= to factorize() and never need to set it.
+    mesh_shape: tuple | None = None
 
     def replace(self, **kw) -> "Options":
         return dataclasses.replace(self, **kw)

@@ -299,6 +299,26 @@ def _shard_vals(dtype) -> bool:
     return np.dtype(dtype).kind != "c"
 
 
+def _aot_wrap_dist(name: str, jfn, dsched, mesh, axis, dtype,
+                   trans: bool):
+    """AOT-wrap a shard_map'd dist solve program (resilience/aot.py,
+    ISSUE 17) — fingerprint carries the mesh legs (shape + axis +
+    device kinds) on top of the schedule layout, so a cold process
+    deserializes the export only for the IDENTICAL mesh and refuses
+    typed (AotMismatch) otherwise.  Complex lanes are never wrapped
+    (the platform-gate note at batched._phase_fns); an unexportable
+    shard_map falls back to the plain jit inside AotJit."""
+    if np.dtype(dtype).kind == "c":
+        return jfn
+    from ..resilience import aot
+    return aot.wrap_jit(
+        name, jfn,
+        aot.schedule_fingerprint(
+            dsched, dtype,
+            extra=(name, bool(trans))
+            + aot.mesh_fingerprint_legs(mesh, axis)))
+
+
 def make_dist_step(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
                    axis=None):
     """Build the fused distributed factor+solve step:
@@ -407,9 +427,25 @@ def make_dist_factor(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
         body, mesh=mesh, in_specs=(vspec,) + idx_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
         check_vma=False)
-    jitted = obs.watch_jit(
-        "dist_factor", jax.jit(lambda vsel: mapped(vsel, *idx_args)),
-        cost_phase="FACT")
+    # AOT persistence (resilience/aot.py, ISSUE 17): the shard_map'd
+    # whole-phase factor exports like the single-device phase programs
+    # — the fingerprint gains the mesh legs (shape + axis + device
+    # kinds) so a mesh reshape refuses typed instead of dispatching a
+    # program compiled for a different collective topology.  Complex
+    # lanes skip AOT (the platform-gate note at batched._phase_fns),
+    # and an unexportable shard_map falls back to the plain jit inside
+    # AotJit — never a dispatch break.
+    from ..resilience import aot
+    factor_fn = jax.jit(lambda vsel: mapped(vsel, *idx_args))
+    if sharded_in:
+        factor_fn = aot.wrap_jit(
+            "dist_factor", factor_fn,
+            aot.schedule_fingerprint(
+                dsched, dtype,
+                extra=("dist_factor",)
+                + aot.mesh_fingerprint_legs(mesh, axis)))
+    jitted = obs.watch_jit("dist_factor", factor_fn,
+                           cost_phase="FACT")
     vshard = jax.sharding.NamedSharding(mesh, P(axis))
 
     def factor(vals) -> DistLU:
@@ -525,6 +561,8 @@ def make_dist_solve_merged(plan: FactorPlan, mesh: Mesh,
     def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
         return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
 
+    solve = _aot_wrap_dist("dist_solve_merged", solve, dsched, mesh,
+                           axis, dtype, trans)
     return obs.watch_jit("dist_solve_merged", solve,
                          cost_phase="SOLVE")
 
@@ -622,6 +660,8 @@ def make_dist_solve(plan: FactorPlan, mesh: Mesh, dtype=np.float64,
     def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
         return mapped(L_flat, U_flat, Li_flat, Ui_flat, b, *idx_args)
 
+    solve = _aot_wrap_dist("dist_solve", solve, dsched, mesh, axis,
+                           dtype, trans)
     return obs.watch_jit("dist_solve", solve, cost_phase="SOLVE")
 
 
@@ -710,8 +750,11 @@ def make_dist_solve_rhs_sharded(plan: FactorPlan, mesh: Mesh,
         _hi_prec(body), mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(None, axis)),
         out_specs=P(None, axis), check_vma=False)
-    jitted = obs.watch_jit("dist_solve_rhs_sharded", jax.jit(mapped),
-                           cost_phase="SOLVE")
+    jitted = obs.watch_jit(
+        "dist_solve_rhs_sharded",
+        _aot_wrap_dist("dist_solve_rhs_sharded", jax.jit(mapped),
+                       dsched, mesh, axis, dtype, trans),
+        cost_phase="SOLVE")
 
     def solve(L_flat, U_flat, Li_flat, Ui_flat, b):
         r = b.shape[1]
@@ -784,7 +827,44 @@ def measure_comm(dlu: DistLU, nrhs: int = 1) -> dict:
     txt = lowerable.lower(dlu.L_flat, dlu.U_flat, dlu.Li_flat,
                           dlu.Ui_flat, b).compile().as_text()
     out["SOLVE"] = hlo_collective_stats(txt)
+    # mesh stamps (ISSUE 17 satellite): scalar legs the bench records
+    # carry into SOLVE_LATENCY/MULTICHIP lines so tools/regress.py can
+    # hold PER-DEVICE and PER-BOUNDARY ceilings, not just totals — a
+    # mesh twice the size must not get twice the collective allowance.
+    syncs = int(dlu.schedule.comm_summary(dlu.dtype, nrhs)
+                .get("solve_syncs", 0))
+    psum_b = int(out["SOLVE"].get("all-reduce", {}).get("bytes", 0))
+    out["MESH"] = {
+        "n_devices": int(ndev),
+        "mesh_shape": "x".join(str(int(dlu.mesh.shape[a]))
+                               for a in dlu.mesh.axis_names),
+        "axis_names": ",".join(str(a) for a in dlu.mesh.axis_names),
+        "solve_syncs": syncs,
+        "solve_psum_bytes_per_boundary": (psum_b // syncs if syncs
+                                          else 0),
+        "solve_arm": ("rhs_sharded" if sharded_rhs
+                      else ("merged" if merged else "replicated")),
+    }
     return out
+
+
+def dist_solve_cache_size(dlu: DistLU) -> int:
+    """Compiled-signature count across every dist solve program built
+    for this handle's plan — the mesh replica's analog of
+    trisolve.solve_packed_cache_size, and the probe the serve layer's
+    zero-recompile pin reads (serve/service.py solve_jit_cache_size).
+    -1 when no solve program exists yet."""
+    cache = getattr(dlu.plan, "_dist_solve_fns", None)
+    if not cache:
+        return -1
+    total = 0
+    for fn in cache.values():
+        j = getattr(fn, "jitted", fn)
+        try:
+            total += int(j._cache_size())
+        except AttributeError:
+            return -1
+    return total
 
 
 def _rhs_sharded_auto(nrhs: int, ndev: int) -> bool:
@@ -828,3 +908,96 @@ def dist_solve(dlu: DistLU, b_factor_order, trans: bool = False):
                         axis=dlu.axis, trans=trans)
     return cache[key](dlu.L_flat, dlu.U_flat, dlu.Li_flat,
                       dlu.Ui_flat, b_factor_order)
+
+
+# --------------------------------------------------------------------
+# slulint HLO contracts (tools/slulint/contracts.py): the mesh solve
+# program's compiled shape, statically checkable because the task
+# graph is fixed before numerics run
+# --------------------------------------------------------------------
+
+_CONTRACT_MEMO: dict = {}
+
+
+def _contract_dlu():
+    """A 2-device CPU mesh + a small factored DistLU — the
+    representative signature the mesh-solve contracts lower at.
+    Memoized: both entries share one factorization.  Returns None
+    when no 2-device mesh is possible (backend already initialized
+    single-device) — the contracts then report skipped-ok; the test
+    env (8 forced host devices) asserts them for real."""
+    if "dlu" in _CONTRACT_MEMO:
+        return _CONTRACT_MEMO["dlu"]
+    from ..utils.compat import set_cpu_devices
+    set_cpu_devices(2)
+    if len(jax.devices()) < 2:
+        _CONTRACT_MEMO["dlu"] = None
+        return None
+    from ..options import Options
+    from ..plan.plan import plan_factorization
+    from ..utils.testmat import laplacian_2d
+    mesh = Mesh(np.array(jax.devices()[:2]), axis_names=("z",))
+    a = laplacian_2d(8)
+    plan = plan_factorization(a, Options())
+    dlu = make_dist_factor(plan, mesh)(plan.scaled_values(a))
+    _CONTRACT_MEMO["dlu"] = dlu
+    return dlu
+
+
+def _contract_build_mesh_solve():
+    dlu = _contract_dlu()
+    if dlu is None:
+        raise RuntimeError("no 2-device CPU mesh available")
+    solve = make_dist_solve_merged(dlu.plan, dlu.mesh,
+                                   dtype=dlu.dtype, axis=dlu.axis)
+    b = np.zeros((dlu.schedule.n, 4), dlu.dtype)
+    return solve, (np.asarray(dlu.L_flat), np.asarray(dlu.U_flat),
+                   np.asarray(dlu.Li_flat), np.asarray(dlu.Ui_flat),
+                   b), {}
+
+
+def _contract_psum_per_boundary():
+    """Exactly ONE psum per merged-segment sync boundary (fwd + bwd
+    + the final replicate) in the COMPILED mesh solve — the collapsed
+    C_Tree lsum-reduction discipline (make_dist_solve_merged): a
+    refactor that reintroduces per-supernode reductions multiplies
+    the count and trips this before it prices a single request."""
+    dlu = _contract_dlu()
+    if dlu is None:
+        return True, "skipped: no 2-device CPU mesh"
+    from ..ops import trisolve as tsv
+    from ..utils.stats import hlo_collective_stats
+    fn, args, _ = _contract_build_mesh_solve()
+    compiled = fn.lower(*args).compile()
+    got = hlo_collective_stats(compiled.as_text()).get(
+        "all-reduce", {}).get("count", 0)
+    ts = tsv.get_trisolve(dlu.schedule)
+    want = (sum(map(bool, ts.seg_fwd_sync))
+            + sum(map(bool, ts.seg_bwd_sync)) + 1)
+    return got == want, (f"{got} all-reduce(s) compiled for {want} "
+                         "segment boundaries")
+
+
+def _contract_skip():
+    """Truthy reason when the mesh contracts cannot be judged here
+    (the backend initialized single-device before the checker could
+    provision a host complement)."""
+    return (None if _contract_dlu() is not None
+            else "no 2-device mesh available")
+
+
+HLO_CONTRACTS = (
+    {"name": "dist.solve_merged",
+     "phase": "dist_solve_merged",
+     "contracts": ("no_scatter", "no_host_callback"),
+     "build": _contract_build_mesh_solve,
+     "skip": _contract_skip,
+     "note": "the merged mesh trisolve writes y/update blocks "
+             "DENSELY into device-major slices — a scatter in the "
+             "lowering means the dense-slot discipline broke"},
+    {"name": "dist.solve_psum_per_boundary",
+     "phase": "dist_solve_merged",
+     "check": _contract_psum_per_boundary,
+     "note": "one all-reduce per merged segment boundary, none "
+             "per supernode"},
+)

@@ -65,7 +65,8 @@ class FactorPlan:
         # Stripping them here is what makes the plan (and with it the
         # durable factor store, resilience/store.py) serializable.
         state = dict(self.__dict__)
-        for k in ("_batched_schedules", "_dist_factor_fns"):
+        for k in ("_batched_schedules", "_dist_factor_fns",
+                  "_dist_solve_fns"):
             state.pop(k, None)
         return state
 

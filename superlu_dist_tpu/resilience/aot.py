@@ -176,6 +176,26 @@ def _pattern_sig(sched) -> str:
     return sig
 
 
+def mesh_fingerprint_legs(mesh, axis=None) -> tuple:
+    """Fingerprint legs for a shard_map'd whole-phase program over a
+    device mesh (ISSUE 17): mesh shape as (axis-name, extent) pairs in
+    axis order, the flattened partition axis, and the participating
+    device kinds.  Appended through `schedule_fingerprint`'s `extra`
+    by the parallel/factor_dist.py program builders, so an export
+    recorded on an 8-CPU test mesh refuses (typed AotMismatch, same
+    discipline as every other leg) on a 2x2x2 TPU slice — and any
+    mesh reshape, axis rename, or device-kind change re-keys the
+    entry instead of dispatching a program compiled for a different
+    collective topology."""
+    shape = tuple((str(a), int(mesh.shape[a])) for a in mesh.axis_names)
+    kinds = tuple(sorted({
+        str(getattr(d, "device_kind", None)
+            or getattr(d, "platform", "?"))
+        for d in np.asarray(mesh.devices).ravel()}))
+    ax = axis if axis is None or isinstance(axis, str) else tuple(axis)
+    return ("mesh", shape, repr(ax), kinds)
+
+
 def schedule_fingerprint(sched, dtype, extra=()) -> str:
     """sha256 over everything that shapes a whole-phase program for
     `sched`: the per-group layout (extents AND index content — the

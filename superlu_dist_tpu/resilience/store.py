@@ -47,9 +47,19 @@ the same entry names.
 What is stored: the plan (FactorPlan strips its jit caches via
 __getstate__), effective options, the original matrix (refinement
 residuals need A), and the factor arrays converted to host numpy.
-Device handles are rebuilt on load from the plan's schedule; the
-`dist` backend's mesh-sharded factors are not persistable and are
-skipped.
+Device handles are rebuilt on load from the plan's schedule.
+
+Mesh-resident handles (ISSUE 17).  The `dist` backend's factors live
+sharded over a device mesh, but their GLOBAL flats are ordinary
+ndev-concatenated device-major arrays — gathering them to host numpy
+(kind="dist", with the mesh shape + axis names alongside) makes the
+entry every bit as durable as a single-device one.  The asymmetry is
+on LOAD: rebuilding needs a live mesh of the IDENTICAL shape to
+re-shard onto, so a store opened without one (`store.mesh` unset — a
+single-device replica reading a shared warm tier) REFUSES the entry
+typed (DistMeshUnavailable → `factor_store.refused_dist`) without
+quarantining it: the entry is valid, THIS process just can't host it,
+and the mesh replica that can must still find it intact.
 """
 
 from __future__ import annotations
@@ -86,6 +96,15 @@ class StoreCorrupt(RuntimeError):
     checksum, layout); the load path quarantines and re-factors."""
 
 
+class DistMeshUnavailable(RuntimeError):
+    """A kind="dist" entry is valid but THIS process cannot host it
+    (no `store.mesh`, or a different mesh shape/axes than the factors
+    were sharded over).  A typed refusal, NOT corruption: the load
+    path counts `factor_store.refused_dist` and returns a miss
+    without quarantining — the entry stays intact for a replica whose
+    mesh matches."""
+
+
 def checksum_arrays(arrays) -> str:
     """sha256 over the factor arrays' raw bytes, in order — the
     ABFT-lite content signature."""
@@ -107,6 +126,25 @@ def entry_name(key) -> str:
     return h.hexdigest()[:40] + SUFFIX
 
 
+def _entry_arrays(lu: LUFactorization):
+    """The numeric payload of a handle as host numpy: factor_arrays
+    for host/jax backends, the gathered global flats for dist (the
+    mesh-sharded arrays are fully addressable, so np.asarray assembles
+    the device-major concatenation — exactly what device_put with the
+    same NamedSharding re-shards on load)."""
+    if lu.backend == "dist":
+        d = lu.device_lu
+        return [np.asarray(d.L_flat), np.asarray(d.U_flat),
+                np.asarray(d.Li_flat), np.asarray(d.Ui_flat)]
+    return factor_arrays(lu)
+
+
+def _mesh_legs(mesh) -> tuple:
+    """Shape signature a dist entry is valid against: ordered
+    (axis-name, size) pairs."""
+    return tuple((str(a), int(mesh.shape[a])) for a in mesh.axis_names)
+
+
 def _device_layout(lu: LUFactorization):
     """Slab-layout fingerprint of a device handle's schedule; None for
     host factors (panel layout is env-independent)."""
@@ -125,11 +163,15 @@ class FactorStore:
     Thread-safe; counters go to the injected metrics object
     (duck-typed `.inc`) under `factor_store.*`."""
 
-    def __init__(self, root: str, metrics=None) -> None:
+    def __init__(self, root: str, metrics=None, mesh=None) -> None:
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self._metrics = metrics
         self._lock = threading.Lock()
+        # live device mesh kind="dist" entries rebuild onto (set by
+        # FactorCache when serving mesh-resident); None ⇒ dist
+        # entries refuse typed on load
+        self.mesh = mesh
 
     def _inc(self, name: str) -> None:
         if self._metrics is not None:
@@ -152,13 +194,11 @@ class FactorStore:
     # -- write path ----------------------------------------------------
 
     def save(self, key, lu: LUFactorization) -> str | None:
-        """Persist `lu` under `key` atomically; returns the path, or
-        None for non-persistable handles (dist backend)."""
+        """Persist `lu` under `key` atomically; returns the path."""
+        arrays = _entry_arrays(lu)
         if lu.backend == "dist":
-            self._inc("factor_store.skipped_dist")
-            return None
-        arrays = factor_arrays(lu)
-        if lu.backend == "host":
+            kind = "dist"
+        elif lu.backend == "host":
             kind = "host"
         elif hasattr(lu.device_lu, "panels"):
             kind = "staged"
@@ -184,6 +224,14 @@ class FactorStore:
             "layout": _device_layout(lu),
             "checksum": checksum_arrays(arrays),
         }
+        if kind == "dist":
+            d = lu.device_lu
+            # the mesh signature the flats were sharded over: load
+            # refuses (typed) unless the reader's mesh matches
+            payload["mesh_shape"] = _mesh_legs(d.mesh)
+            payload["dist_axis"] = (d.axis if isinstance(d.axis, str)
+                                    or d.axis is None
+                                    else tuple(d.axis))
         blob = pickle.dumps(payload, protocol=4)
         framed = _MAGIC + hashlib.sha256(blob).digest() + blob
         # chaos site: a slow shared warm tier (store_latency) — the
@@ -242,12 +290,23 @@ class FactorStore:
             if expect_key is not None and payload["key"] != expect_key:
                 raise StoreCorrupt("key echo mismatch")
             lu = self._rebuild(payload)
-            if checksum_arrays(factor_arrays(lu)) \
+            if checksum_arrays(_entry_arrays(lu)) \
                     != payload["checksum"]:
                 raise StoreCorrupt("factor checksum mismatch")
             if not factors_finite(lu):
                 raise StoreCorrupt("persisted factors non-finite")
             return payload["key"], lu
+        except DistMeshUnavailable as e:
+            # typed refusal, NOT corruption: the entry is valid for a
+            # mesh this process doesn't have — leave it on disk for
+            # the replica that does, count it, report a miss
+            from .. import obs
+            self._inc("factor_store.refused_dist")
+            obs.instant("resilience.store_refused_dist",
+                        cat="resilience",
+                        args={"entry": os.path.basename(path),
+                              "reason": str(e)[:200]})
+            return None
         except Exception as e:
             self.quarantine(path, reason=repr(e))
             return None
@@ -260,6 +319,52 @@ class FactorStore:
         arrays = payload["arrays"]
         kind = payload["kind"]
         st = Stats()
+        if kind == "dist":
+            # mesh-resident rebuild: re-shard the persisted global
+            # flats onto the CURRENT process's mesh.  The warm path is
+            # real — device_put of the verified flats, no
+            # refactorization — but only onto the identical mesh
+            # signature; anything else refuses typed.
+            mesh = self.mesh
+            if mesh is None:
+                raise DistMeshUnavailable(
+                    "kind=dist entry needs a live device mesh "
+                    "(store.mesh unset: single-device reader)")
+            if _mesh_legs(mesh) != tuple(payload["mesh_shape"]):
+                raise DistMeshUnavailable(
+                    f"mesh {_mesh_legs(mesh)} != saved "
+                    f"{tuple(payload['mesh_shape'])}")
+            arrays = payload["arrays"]
+            if len(arrays) != 4:
+                raise StoreCorrupt("dist payload needs 4 flats")
+            if not all(np.isfinite(x).all() for x in arrays):
+                # factors_finite is trivially True for live dist
+                # handles (mesh-bound probe), so the finiteness leg of
+                # verification runs here on the host flats instead
+                raise StoreCorrupt("persisted dist factors non-finite")
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..ops import batched
+            from ..parallel import factor_dist as fd
+            axis, ndev = fd._resolve_axis(mesh, payload["dist_axis"])
+            sched = batched.get_schedule(plan, ndev)
+            shard = NamedSharding(mesh, PartitionSpec(axis))
+            L, U, Li, Ui = (jax.device_put(x, shard) for x in arrays)
+            dev = fd.DistLU(plan=plan, mesh=mesh, axis=axis,
+                            dtype=np.dtype(payload["dtype"]),
+                            schedule=sched, L_flat=L, U_flat=U,
+                            Li_flat=Li, Ui_flat=Ui,
+                            tiny_pivots=payload["tiny_pivots"])
+            lu = LUFactorization(plan=plan, backend="dist",
+                                 device_lu=dev, a=mat, stats=st)
+            if payload.get("layout") is not None \
+                    and _device_layout(lu) != payload["layout"]:
+                raise StoreCorrupt(
+                    "schedule layout changed since save (env knobs "
+                    "moved slab offsets); refusing misaligned factors")
+            lu.options = payload["options"]
+            st.lu_nnz = plan.lu_nnz()
+            return lu
         if kind == "host":
             from ..ops.ref_multifrontal import HostLU
             ns = plan.frontal.nsuper

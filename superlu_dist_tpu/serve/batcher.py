@@ -51,9 +51,23 @@ def _trisolve_arm(lu) -> str:
     """The solve arm serving this dispatch (ops/trisolve.active_arm,
     resolved against the handle so a staged or non-Pallas-capable
     factorization is never labeled '+pallas'); import deferred so the
-    batcher never pays an ops import on the module path."""
+    batcher never pays an ops import on the module path.  A
+    mesh-resident handle (dist backend, ISSUE 17) is its own arm —
+    its dispatch granularity is the shard_map'd whole-phase sweep,
+    not any single-device trisolve variant."""
+    if getattr(lu, "backend", None) == "dist":
+        return "dist"
     from ..ops.trisolve import active_arm
     return active_arm(getattr(lu, "device_lu", None))
+
+
+def _mesh_leg(lu) -> str | None:
+    """Mesh-shape label for flight records ("2x2x2"); None for
+    single-device handles, so the leg costs nothing off-mesh."""
+    if getattr(lu, "backend", None) != "dist":
+        return None
+    m = lu.device_lu.mesh
+    return "x".join(str(int(m.shape[a])) for a in m.axis_names)
 
 
 def bucket_for(nrhs: int, ladder=BUCKET_LADDER) -> int:
@@ -109,6 +123,11 @@ class MicroBatcher:
         self.dtype = (np.dtype(dtype) if dtype is not None
                       else solve_rhs_dtype(lu))
         self.cast_rhs = cast_rhs
+        # mesh residency label, resolved once (the handle's mesh is
+        # immutable for the batcher's lifetime): rides every combined
+        # queue flight event so p99 attribution can split mesh vs
+        # single-device dispatches
+        self._mesh_leg = _mesh_leg(lu)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: list[_Request] = []
@@ -360,7 +379,8 @@ class MicroBatcher:
                 r.flight.event(
                     "queue", wait_us=int((now - r.t_submit) * 1e6),
                     batch=bid, bucket=k, occupancy=occ,
-                    solve_us=solve_us, arm=arm)
+                    solve_us=solve_us, arm=arm,
+                    mesh=self._mesh_leg)
             if r.deadline is not None and done > r.deadline:
                 # the work is done, but a missed deadline must never
                 # read as success — the caller already moved on

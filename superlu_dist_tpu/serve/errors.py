@@ -212,8 +212,16 @@ def factor_cost_hint_s(arm: str | None = None) -> float | None:
     longer exists."""
     if arm is None:
         try:
-            from ..ops.batched import factor_arm
-            arm = factor_arm()
+            # mesh-resident serving (ISSUE 17) factors through the
+            # shard_map'd dist program — a different cost curve from
+            # every single-device arm, so it gets its own ledger arm
+            # and leases sized under a mesh never inherit single-chip
+            # walls (or vice versa)
+            if flags.env_int("SLU_SERVE_MESH", 0):
+                arm = "dist"
+            else:
+                from ..ops.batched import factor_arm
+                arm = factor_arm()
         except Exception:           # noqa: BLE001 — hint, not gate:
             arm = None              # any resolution failure degrades
                                     # to the arm-less freshest record

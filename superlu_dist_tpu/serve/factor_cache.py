@@ -145,10 +145,18 @@ class FactorCache:
                  breaker=None,
                  retry=None,
                  fleet=None,
-                 validate_factors: bool = True) -> None:
+                 validate_factors: bool = True,
+                 mesh=None) -> None:
         self.capacity_bytes = capacity_bytes
         self.max_plans = max_plans
         self.backend = backend
+        # device-mesh residency (ISSUE 17): with a mesh attached every
+        # factorization this cache leads runs through the dist backend
+        # (grid=mesh) and the resident handles are DistLU-backed —
+        # factor once across the mesh, solve from all chips.  The
+        # service stamps Options.mesh_shape on every keyed request, so
+        # mesh and single-device entries can never serve each other.
+        self.mesh = mesh
         self.metrics = metrics or Metrics()
         self._factorize_fn = factorize_fn or self._default_factorize
         # durable persistence tier (resilience/store.py): read-through
@@ -160,6 +168,11 @@ class FactorCache:
             # adopt an explicitly-passed store into this cache's
             # metrics so its saves/hits/quarantines are observable
             self.store._metrics = self.metrics
+        if self.store is not None and mesh is not None:
+            # hand the mesh to the store so persisted dist entries can
+            # rebuild onto it (kind="dist" round-trip); a store with
+            # no mesh refuses those entries typed instead
+            self.store.mesh = mesh
         # per-key circuit breaker + bounded retry (resilience/): the
         # containment pair around _acquire_factors.  Both default off
         # for direct cache users; SolveService wires them from
@@ -599,6 +612,9 @@ class FactorCache:
     def _default_factorize(self, a, options, plan):
         if plan is None:
             plan = plan_factorization(a, options)
+        if self.mesh is not None:
+            return factorize(a, options, plan=plan, backend="dist",
+                             grid=self.mesh)
         return factorize(a, options, plan=plan, backend=self.backend)
 
     def put(self, key: CacheKey, lu: LUFactorization) -> None:

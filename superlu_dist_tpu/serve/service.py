@@ -170,6 +170,26 @@ def _mark_degraded(fut: Future) -> Future:
     return out
 
 
+def _mesh_from_env():
+    """The serve mesh from SLU_SERVE_MESH/SLU_MESH_SHAPE (flags.py),
+    or None (single-device serving, the default).  SLU_SERVE_MESH=1
+    turns mesh residency on; SLU_MESH_SHAPE names the grid ("2x2x2",
+    "8"; default: all local devices on one flat axis).  Resolved once
+    per ServeConfig construction — building a Mesh touches the device
+    client, so the off path must stay one env read."""
+    if not flags.env_int("SLU_SERVE_MESH", 0):
+        return None
+    import jax
+    from ..parallel.grid import make_solver_mesh
+    shape = flags.env_str("SLU_MESH_SHAPE", "").strip()
+    if shape:
+        dims = [int(d) for d in shape.lower().split("x")]
+    else:
+        dims = [len(jax.devices())]
+    dims = (dims + [1, 1])[:3]
+    return make_solver_mesh(*dims).mesh
+
+
 @dataclasses.dataclass
 class ServeConfig:
     """Service policy knobs (the serving analog of Options)."""
@@ -225,6 +245,15 @@ class ServeConfig:
     # adopt the published entry.  SLU_FLEET=1 flips the default.
     fleet: bool = dataclasses.field(
         default_factory=lambda: bool(flags.env_int("SLU_FLEET", 0)))
+    # --- device-mesh residency (ISSUE 17) ---
+    # jax.sharding.Mesh the replica's factorizations shard over: the
+    # cache factors through the dist backend (grid=mesh) and every
+    # keyed request is stamped with Options.mesh_shape, so mesh and
+    # single-device entries can never serve each other's requests.
+    # None = single-device serving; default from SLU_SERVE_MESH /
+    # SLU_MESH_SHAPE.
+    mesh: object | None = dataclasses.field(
+        default_factory=_mesh_from_env)
     # multi-tenant QoS gate (fleet/policy.py QosGate, duck-typed:
     # anything with admit(tenant)): consulted at the front door for
     # requests carrying a tenant= label; a refusal raises
@@ -288,7 +317,7 @@ class SolveService:
             self.cache = FactorCache(
                 capacity_bytes=cfg.capacity_bytes,
                 backend=cfg.backend, metrics=self.metrics,
-                store=store,
+                store=store, mesh=cfg.mesh,
                 # True = coordinator over whatever store the cache
                 # resolves (store_dir OR SLU_FT_STORE); False = an
                 # explicit opt-out SLU_FLEET=1 must not override
@@ -348,6 +377,21 @@ class SolveService:
 
     # -- operator surface ---------------------------------------------
 
+    def _stamp_mesh(self, options: Options) -> Options:
+        """Stamp the replica's mesh shape onto the request's options
+        (Options.mesh_shape, a FACTOR_KEY_FIELDS leg) so every key
+        this service creates names the residency it serves from —
+        mesh-factored entries are a MISS for single-device requests
+        and vice versa, across the cache, the durable store
+        (entry_name hashes the options) and the fleet routing key.
+        An explicit caller-set mesh_shape wins (tests pinning
+        cross-residency misses rely on that)."""
+        mesh = self.config.mesh
+        if mesh is None or options.mesh_shape is not None:
+            return options
+        return options.replace(mesh_shape=tuple(
+            int(mesh.shape[a]) for a in mesh.axis_names))
+
     def prefactor(self, a: CSRMatrix, options: Options | None = None
                   ) -> CacheKey:
         """Warm a key out of band: factorize (single-flight), then
@@ -357,7 +401,7 @@ class SolveService:
         with self._lock:
             if self._closed:
                 raise ServeError("service is closed")
-        options = options or Options()
+        options = self._stamp_mesh(options or Options())
         key = matrix_key(a, options)
         lu = self.cache.get_or_factorize(a, options, key=key)
         with self._lock:
@@ -380,6 +424,8 @@ class SolveService:
             if self._closed:
                 raise ServeError("service is closed")
         from ..stream.pipeline import StreamHandle
+        if options is not None or self.config.mesh is not None:
+            options = self._stamp_mesh(options or Options())
         h = StreamHandle(self, a, options, config)
         with self._lock:
             # close() may have drained _streams while the prime
@@ -719,7 +765,8 @@ class SolveService:
                 with self._lock:
                     options = self._prefactor_opts.get(key)
         else:
-            key = matrix_key(a, options or Options())
+            options = self._stamp_mesh(options or Options())
+            key = matrix_key(a, options)
             self.cache.note_demand(key)
             resident = self.cache.peek(key, touch=False) is not None
             if not resident and self.config.dtype_tiers:
@@ -1121,6 +1168,13 @@ def solve_jit_cache_size(lu: LUFactorization) -> int:
     warmup contract (tests assert it is flat across a load run).
     Returns -1 when the handle has no single jitted solve program
     (host backend, staged per-group execution)."""
+    if lu.backend == "dist" and lu.device_lu is not None:
+        # mesh replica (ISSUE 17): the handle dispatches through the
+        # plan-level dist solve cache — sum every compiled signature
+        # across its arms (replicated / merged / rhs-sharded), so a
+        # ladder-induced recompile on ANY arm moves this probe
+        from ..parallel.factor_dist import dist_solve_cache_size
+        return dist_solve_cache_size(lu.device_lu)
     if lu.backend != "jax" or lu.device_lu is None:
         return -1
     from ..ops import batched, trisolve

@@ -236,7 +236,12 @@ class Stats:
             lines.append("** Collective traffic (measured, compiled HLO) **")
             for phase, kinds in self.comm_measured.items():
                 for k, v in kinds.items():
-                    lines.append(f"  {phase}/{k:<18s} "
-                                 f"count {v['count']:<5d} "
-                                 f"bytes {v['bytes']}")
+                    if isinstance(v, dict):
+                        lines.append(f"  {phase}/{k:<18s} "
+                                     f"count {v['count']:<5d} "
+                                     f"bytes {v['bytes']}")
+                    else:
+                        # scalar mesh stamps (measure_comm "MESH"):
+                        # n_devices, per-boundary bytes, arm
+                        lines.append(f"  {phase}/{k:<18s} {v}")
         return "\n".join(lines)

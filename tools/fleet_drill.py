@@ -34,6 +34,16 @@ tools/regress.py gates the committed history.  Wire-up:
 tpu_fire.sh fleet step.  Knobs: SLU_FLEET_REPLICAS / SLU_FLEET_K /
 SLU_FLEET_REQUESTS / SLU_FLEET_KILL_AFTER / SLU_FLEET_TTL_S.
 
+MESH-REPLICA ARM (ISSUE 17): `SLU_FLEET_MESH=N` runs every replica
+as a MESH replica — an in-process N-device CPU mesh
+(utils/compat.set_cpu_devices, the shard_map'd dist backend) behind
+the same SolveService front.  The same gates then prove the
+mesh-resident story: cross-process single-flight holds when the
+cold-key LEADER is a mesh (one dist factorization pool-wide, siblings
+adopt the kind="dist" store entry), and the kill's warm takeover
+re-shards persisted flats instead of re-factoring (takeover
+factorizations == 0 over mesh-resident keys).
+
 `--day` runs the DAY-IN-THE-LIFE drill instead (ISSUE 16): the
 elastic fleet controller (superlu_dist_tpu/fleet/controller.py)
 driving popularity-based prefactor, SLO-burn-triggered weighted shed
@@ -75,11 +85,18 @@ def _drill_matrices(k: int, n_keys: int):
 
 def run_replica(name: str, socket_path: str, store_dir: str,
                 k: int, n_keys: int, factor_delay_s: float,
-                ttl_s: float) -> None:
+                ttl_s: float, mesh_ndev: int = 0) -> None:
     """One replica: a SolveService on the shared store with fleet
     single-flight, served over a unix socket.  Protocol: one pickled
     dict per request — solve / stats / chaos / chaos_off / die /
-    ping / close."""
+    ping / close.  `mesh_ndev` > 0 makes this a MESH replica: an
+    in-process mesh of that many virtual CPU devices, factoring and
+    solving through the shard_map'd dist backend."""
+    if mesh_ndev:
+        # before any jax backend init: the device count is a
+        # process-creation property
+        from superlu_dist_tpu.utils.compat import set_cpu_devices
+        set_cpu_devices(int(mesh_ndev))
     from multiprocessing.connection import Listener
 
     import numpy as np
@@ -100,6 +117,12 @@ def run_replica(name: str, socket_path: str, store_dir: str,
     slo.configure()             # adopt SLU_SLO (day drill sets it)
     mats = _drill_matrices(k, n_keys)
     opts = Options(factor_dtype="float64")
+    mesh_obj = None
+    if mesh_ndev:
+        import jax
+        from jax.sharding import Mesh
+        mesh_obj = Mesh(np.array(jax.devices()[:int(mesh_ndev)]),
+                        axis_names=("z",))
 
     def slow_factorize(a, options, plan):
         # stand-in for the minutes-long production factorization:
@@ -109,6 +132,9 @@ def run_replica(name: str, socket_path: str, store_dir: str,
         from superlu_dist_tpu.plan.plan import plan_factorization
         if plan is None:
             plan = plan_factorization(a, options)
+        if mesh_obj is not None:
+            return factorize(a, options, plan=plan, backend="dist",
+                             grid=mesh_obj)
         return factorize(a, options, plan=plan, backend="host")
 
     store = FactorStore(store_dir)
@@ -118,11 +144,11 @@ def run_replica(name: str, socket_path: str, store_dir: str,
         max_queue_depth=1024, backend="host", degraded=True,
         factor_retries=1, retry_base_s=0.01,
         breaker_threshold=3, breaker_cooldown_s=1.0, fleet=False,
-        qos=qos),
+        qos=qos, mesh=mesh_obj),
         cache=FactorCache(
             backend="host", store=store, fleet=coord,
             breaker=CircuitBreaker(threshold=3, cooldown_s=1.0),
-            factorize_fn=slow_factorize))
+            factorize_fn=slow_factorize, mesh=mesh_obj))
     keys = [matrix_key(m, opts) for m in mats]
     key_index = {kk: i for i, kk in enumerate(keys)}
 
@@ -338,6 +364,9 @@ def run_drill(argv=()) -> dict:
     # which scales off the measured minutes-class factorization and
     # would dwarf the drill's 60 s per-request / 300 s join budgets)
     ttl_s = float(os.environ.get("SLU_FLEET_TTL_S") or 0.0) or 20.0
+    # mesh-replica arm (ISSUE 17): every replica fronts an in-process
+    # N-device CPU mesh and factors through the dist backend
+    mesh_ndev = int(os.environ.get("SLU_FLEET_MESH", "0"))
     out_path = os.environ.get("SLU_FLEET_OUT",
                               os.path.join(repo, "FLEET.jsonl"))
     n_keys = 4
@@ -358,6 +387,7 @@ def run_drill(argv=()) -> dict:
     procs: dict = {}
     report: dict = {"mode": "fleet", "replicas": n_replicas, "k": k,
                     "requests": requests, "keys": n_keys,
+                    "mesh_ndev": mesh_ndev,
                     "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
     try:
         for n in names:
@@ -367,7 +397,8 @@ def run_drill(argv=()) -> dict:
                  "--store", store_dir, "--k", str(k),
                  "--keys", str(n_keys),
                  "--factor-delay", str(factor_delay_s),
-                 "--ttl", str(ttl_s)],
+                 "--ttl", str(ttl_s),
+                 "--mesh", str(mesh_ndev)],
                 cwd=repo, env=env)
         down: set = set()
         lock = threading.Lock()
@@ -1204,7 +1235,8 @@ def main() -> None:
                     k=int(opt("--k", "4")),
                     n_keys=int(opt("--keys", "4")),
                     factor_delay_s=float(opt("--factor-delay", "0.5")),
-                    ttl_s=float(opt("--ttl", "20")))
+                    ttl_s=float(opt("--ttl", "20")),
+                    mesh_ndev=int(opt("--mesh", "0")))
         return
     repo = _repo()
     if "--day" in argv:

@@ -41,6 +41,10 @@ tolerance:
                  swaps >= 1, overlap_ratio <= the declared ceiling
                  (stream p99 within 1.10x of the pinned arm — the
                  background refactor provably overlaps), gate.passed
+  * multichip  — mesh-resident serving A/B (bench.py
+                 --multichip-serve, MULTICHIP_r*.json): solves/s
+                 floor, p99 ceiling, recompiles == 0,
+                 bitwise_vs_mesh_oracle == True, gate.passed
   * bench      — GFLOP/s floor
 
 Usage:
@@ -208,6 +212,20 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "GAUNTLET.jsonl")):
         if rec.get("mode") == "gauntlet":
             add(rec.get("platform"), "gauntlet", rec)
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "MULTICHIP_r*.json"))):
+        # mesh-resident serving A/B records (bench.py
+        # --multichip-serve); pre-ISSUE-17 rounds are driver wrappers
+        # with no mode field and are not this gate's to judge
+        try:
+            doc = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        if (isinstance(doc, dict)
+                and doc.get("mode") == "multichip_serve"
+                and not doc.get("measurement_invalid")
+                and not doc.get("skipped")):
+            add(doc.get("platform"), "multichip", doc)
     for rec in _bench_records(root):
         add(rec.get("platform"), "bench", rec)
     return hist
@@ -526,6 +544,32 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the hard-matrix gauntlet gate "
                     "itself failed"))
+            elif chk == "multichip":
+                floor_check(p, chk, "solves_per_s",
+                            _num(latest, "solves_per_s"),
+                            base.get("solves_per_s"),
+                            tol["throughput_drop_frac"])
+                ceil_check(p, chk, "p99_ms", _num(latest, "p99_ms"),
+                           base.get("p99_ms"),
+                           tol["latency_rise_frac"])
+                zero_check(p, chk, "recompiles_under_load",
+                           _num(latest, "recompiles_under_load"),
+                           "the mesh replica's jit recompiled under "
+                           "the batcher ladder load")
+                v = latest.get("bitwise_vs_mesh_oracle")
+                if v is not None:
+                    findings.append(_finding(
+                        p, chk, "bitwise_vs_mesh_oracle", bool(v),
+                        True, True, "ok" if v else "fail",
+                        "" if v else "the serve-path mesh solve "
+                        "diverged from mesh_oracle_solve bitwise"))
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the multichip serve A/B gate "
+                    "itself failed"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -592,6 +636,11 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "gauntlet":
                 dst[chk] = {}          # structural zero-gates only
+            elif chk == "multichip":
+                dst[chk] = {
+                    m: _median([v for r in win
+                                if (v := _num(r, m)) is not None])
+                    for m in ("solves_per_s", "p99_ms")}
             elif chk == "bench":
                 dst[chk] = {"gflops": _median(
                     [v for r in win

@@ -18,7 +18,13 @@ Entry schema (a plain dict — package modules must not import tools/):
      "env":       {"SLU_TRISOLVE": "merged"},  # applied around build
      "build":     <callable>,                  # -> (fn, args, kwargs)
      "check":     <callable>,                  # OR: -> (ok, msg)
+     "skip":      <callable>,                  # optional: -> reason|None
      "note":      "why this invariant exists"}
+
+`skip` (optional) declares an environmental precondition: a truthy
+return (the reason string) means the contract cannot be judged in
+this environment — the entry is passed over, never reported (the
+mesh contracts need a >=2-device complement).
 
 `build` returns a lowerable callable plus representative arguments;
 the named checks run on `fn.lower(*args, **kwargs).as_text()`.
@@ -57,6 +63,21 @@ _CALLBACK_TOKENS = ("xla_python_cpu_callback", "xla_ffi_python",
 def scatter_count(hlo_text: str) -> int:
     """Occurrences of scatter ops in a lowered/compiled module text."""
     return hlo_text.lower().count("scatter")
+
+
+def collective_count(hlo_text: str, kind: str = "all-reduce") -> int:
+    """Occurrences of a collective kind in a module text — counts
+    both compiled-HLO spellings (`all-reduce(`, async `-done`) and
+    StableHLO spellings (`stablehlo.all_reduce`).  The predicate the
+    mesh-solve boundary contract is built on
+    (parallel/factor_dist.HLO_CONTRACTS: exactly one psum per merged
+    segment boundary)."""
+    hlo = len(re.findall(
+        rf"= [^=]*? {re.escape(kind)}(?:-done)?\(", hlo_text))
+    shlo = len(re.findall(
+        rf"stablehlo\.{re.escape(kind.replace('-', '_'))}\b",
+        hlo_text))
+    return hlo + shlo
 
 
 def has_f64(hlo_text: str) -> bool:
@@ -116,6 +137,7 @@ CONTRACT_MODULES = (
     "superlu_dist_tpu.ops.batched",
     "superlu_dist_tpu.precision.doubleword",
     "superlu_dist_tpu.numerics.gscon",
+    "superlu_dist_tpu.parallel.factor_dist",
 )
 
 
@@ -181,6 +203,18 @@ def check_entry(entry: dict) -> list[Finding]:
     name = entry["name"]
     path = entry.get("module", "?").replace(".", "/") + ".py"
     out = []
+    skip = entry.get("skip")
+    if skip is not None:
+        # environmental precondition (e.g. the mesh contracts need a
+        # >=2-device complement): a truthy reason means the contract
+        # cannot be judged HERE — not that it is violated
+        try:
+            with _EnvPatch(entry.get("env")):
+                why = skip()
+        except Exception as e:  # noqa: BLE001 — report, not crash
+            why = f"skip probe failed: {e}"
+        if why:
+            return out
     try:
         if "check" in entry:
             with _EnvPatch(entry.get("env")):
@@ -225,6 +259,18 @@ def check_all(root: str | None = None) -> list[Finding]:
         return [Finding(RULE, "tools/slulint/contracts.py", 0,
                         f"contract registry import failed: {e}",
                         detail="registry:import")]
+    # the mesh contracts (parallel/factor_dist) lower on a >=2-device
+    # complement; provision the host devices BEFORE the first entry's
+    # lowering initializes the backend at the 1-device default (a
+    # no-op on an already-initialized backend or a real multichip
+    # platform — the entries then skip themselves)
+    try:
+        if os.environ.get("JAX_PLATFORMS",
+                          "").strip().lower() in ("", "cpu"):
+            from superlu_dist_tpu.utils.compat import set_cpu_devices
+            set_cpu_devices(2)
+    except Exception:               # noqa: BLE001 — best-effort
+        pass
     phases = registered_phases(root)
     for entry in entries:
         ph = entry.get("phase")

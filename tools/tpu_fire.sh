@@ -147,6 +147,21 @@ SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --gauntlet \
   >> "$log" 2>&1
 stamp "gauntlet rc=$?"
 
+# 4e. Mesh-resident serving A/B (ISSUE 17): one-device vs mesh
+#     replica on the same key set through the batcher bucket ladder —
+#     bench.py --multichip-serve writes ONE gated record
+#     (MULTICHIP_r06.json: throughput/p99 per arm, recompile pin,
+#     bitwise-vs-mesh_oracle_solve, per-boundary collective bytes)
+#     and FAILS persisting nothing on any gate miss.  On hardware the
+#     mesh is the local chip complement; in the dryrun the bench
+#     provisions a set_cpu_devices(8) host mesh itself, so this runs
+#     in both modes and never spends tunnel time.  Numbered 4e for
+#     the record series it extends, placed with 3b/3c because it is
+#     dryrun-capable; SLU_REGRESS is moot here (the full sentinel at
+#     the end of the plan gates the committed record).
+timeout 1800 python "$repo/bench.py" --multichip-serve >> "$log" 2>&1
+stamp "multichip-serve A/B rc=$?"
+
 # Everything below step 3 runs on hardware only: the sweep's scale
 # configs compile for many minutes even staged.  The CPU rehearsal's
 # budget claim is steps 1 and 3 (bench + smoke; step 2's profile is
