@@ -1016,6 +1016,147 @@ def _gauntlet():
             f.write(json.dumps(rec) + "\n")
 
 
+def _grad():
+    """`bench.py --grad`: the differentiable-solve gate (ISSUE 18).
+
+    Factorizes one laplacian_3d(SLU_GRAD_K) at f64 on the jax
+    backend, then gates on:
+
+      * FD oracle — d/db and d/dA of a weighted-sum loss vs central
+        differences at fp64 (rtol 1e-6 spot-check);
+      * factorizations == 0 — jax.grad rides the RESIDENT factors;
+      * zero recompiles — a second same-signature grad call misses
+        no compile (obs.COMPILE_WATCH, phases grad_fwd/adjoint);
+      * adjoint cost — median-of-SLU_GRAD_TRIALS adjoint-leg wall
+        within SLU_GRAD_RATIO_MAX of the forward leg on the SAME
+        handle.
+
+    One mode="grad" line appends to SLU_GRAD_OUT (GRAD.jsonl,
+    regress-gated by tools/regress.py).  A failed gate stamps the
+    line measurement_invalid, persists NOTHING, and exits 1 — the
+    --factor-ab discipline."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+
+    from superlu_dist_tpu import (Options, factorize, obs,
+                                  sparse_solve)
+    from superlu_dist_tpu.autodiff import grad_context
+    from superlu_dist_tpu.options import Trans
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    k = int(os.environ.get("SLU_GRAD_K", "10"))
+    trials = max(1, int(os.environ.get("SLU_GRAD_TRIALS", "5")))
+    ratio_max = float(os.environ.get("SLU_GRAD_RATIO_MAX", "1.5"))
+
+    a = laplacian_3d(k)
+    print(f"# grad: factorizing laplacian_3d({k}) n={a.n} ...",
+          file=sys.stderr)
+    lu = factorize(a, Options(factor_dtype="float64"), backend="jax")
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(a.n)
+    bj = jnp.asarray(b)
+    vals = jnp.asarray(a.data)
+    w = jnp.asarray(rng.standard_normal(a.n))
+
+    def loss(v, bb):
+        return (w * sparse_solve(v, bb, lu)).sum()
+
+    fact_before = obs.HEALTH.factorizations
+    gv, gb = jax.grad(loss, argnums=(0, 1))(vals, bj)
+    jax.block_until_ready((gv, gb))
+    factorizations = obs.HEALTH.factorizations - fact_before
+
+    # FD oracle spot-check (central differences at fp64)
+    eps = 1e-6
+    fd_worst = 0.0
+    for i in (0, a.n // 2):
+        bp = b.copy(); bp[i] += eps
+        bm = b.copy(); bm[i] -= eps
+        fd = (float(loss(vals, jnp.asarray(bp)))
+              - float(loss(vals, jnp.asarray(bm)))) / (2 * eps)
+        fd_worst = max(fd_worst,
+                       abs(float(gb[i]) - fd) / max(1.0, abs(fd)))
+    nv = np.asarray(vals)
+    for s in (0, len(nv) // 2):
+        vp = nv.copy(); vp[s] += eps
+        vm = nv.copy(); vm[s] -= eps
+        fd = (float(loss(jnp.asarray(vp), bj))
+              - float(loss(jnp.asarray(vm), bj))) / (2 * eps)
+        fd_worst = max(fd_worst,
+                       abs(float(gv[s]) - fd) / max(1.0, abs(fd)))
+    fd_ok = fd_worst <= 1e-6
+
+    # recompile pin: the second same-signature grad call above the
+    # already-compiled legs must miss nothing
+    miss_before = obs.COMPILE_WATCH.misses()
+    jax.block_until_ready(
+        jax.grad(loss, argnums=(0, 1))(vals, bj))
+    recompiles = obs.COMPILE_WATCH.misses() - miss_before
+
+    # per-leg walls on the SAME handle: forward solve leg vs adjoint
+    # leg, median of `trials`, warmed above
+    ctx = grad_context(lu)
+    fwd_leg, adj_leg = ctx.leg_fns(Trans.NOTRANS)
+    b2 = bj[:, None]
+    x = fwd_leg(ctx.packs, vals, b2)
+    xbar = jnp.asarray(w)[:, None]
+    jax.block_until_ready(adj_leg(ctx.packs, xbar, x))
+    t_fwd, t_adj = [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fwd_leg(ctx.packs, vals, b2))
+        t_fwd.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(adj_leg(ctx.packs, xbar, x))
+        t_adj.append(time.perf_counter() - t0)
+    med_fwd = sorted(t_fwd)[len(t_fwd) // 2]
+    med_adj = sorted(t_adj)[len(t_adj) // 2]
+    ratio = (med_adj / med_fwd) if med_fwd > 0 else float("inf")
+
+    gate = {
+        "passed": bool(fd_ok and factorizations == 0
+                       and recompiles == 0 and ratio <= ratio_max),
+        "fd_ok": bool(fd_ok),
+        "factorizations": int(factorizations),
+        "recompiles": int(recompiles),
+        "ratio_ok": bool(ratio <= ratio_max),
+    }
+    rec = dict(
+        mode="grad", platform=dev.platform,
+        device_kind=getattr(dev, "device_kind", ""),
+        n=int(a.n), nnz=int(len(nv)), k=k, trials=trials,
+        fd_worst_rel=float(fd_worst),
+        factorizations=int(factorizations),
+        recompiles=int(recompiles),
+        forward_ms=round(med_fwd * 1e3, 4),
+        adjoint_ms=round(med_adj * 1e3, 4),
+        adjoint_over_forward=round(ratio, 4),
+        ratio_max=ratio_max, gate=gate,
+        refine_steps=int(os.environ.get("SLU_AD_REFINE", "1")),
+        ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    ok = gate["passed"]
+    if not ok:
+        rec["measurement_invalid"] = True
+    print(json.dumps(rec))
+    if not ok:
+        print(f"# GRAD GATE FAILURE (fd_worst={fd_worst:.3g} "
+              f"factorizations={factorizations} "
+              f"recompiles={recompiles} ratio={ratio:.3f}); "
+              f"record not persisted", file=sys.stderr)
+        raise SystemExit(1)
+    out_path = os.environ.get(
+        "SLU_GRAD_OUT", os.path.join(repo, "GRAD.jsonl"))
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def _multichip_serve():
     """`bench.py --multichip-serve`: the mesh-resident serving A/B
     (ISSUE 17).
@@ -1305,6 +1446,13 @@ def main():
         # gate = zero silent-wrong answers + zero untyped failures;
         # appends to GAUNTLET.jsonl, gated by tools/regress.py
         _gauntlet()
+        return
+    if "--grad" in sys.argv[1:]:
+        # differentiable-solve gate (ISSUE 18): FD oracle, zero new
+        # factorizations under jax.grad, zero recompiles on the
+        # second call, adjoint/forward wall ratio ceiling; appends
+        # to GRAD.jsonl, gated by tools/regress.py
+        _grad()
         return
     if "--multichip-serve" in sys.argv[1:]:
         # mesh-resident serving A/B (ISSUE 17): one-device vs mesh

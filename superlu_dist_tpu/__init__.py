@@ -80,6 +80,12 @@ from .parallel.psymbfact_dist import (  # noqa: E402
 )
 from .utils.io import read_matrix  # noqa: E402
 from .precision import PrecisionPolicy, ResidualMode  # noqa: E402
+from .autodiff import (  # noqa: E402
+    GradResult,
+    grad_context,
+    sparse_solve,
+    vjp_solve,
+)
 
 __version__ = "0.1.0"
 
@@ -104,13 +110,17 @@ __all__ = [
     "LUFactorization",
     "PrecisionPolicy",
     "ResidualMode",
+    "GradResult",
     "factorize",
     "get_diag_u",
+    "grad_context",
     "gssvx",
     "make_solver_mesh",
     "query_space",
     "read_matrix",
     "solve",
+    "sparse_solve",
+    "vjp_solve",
     "warm_solve",
     "__version__",
 ]

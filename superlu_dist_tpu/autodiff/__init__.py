@@ -1,0 +1,22 @@
+"""Differentiable sparse solve: gradients riding resident factors.
+
+`sparse_solve(A_values, b, lu)` makes the solver a first-class JAX
+primitive: the forward leg is the resident merged trisolve
+(ops/trisolve.sweep against the handle's packed factors), the custom
+VJP's backward leg is the SAME handle's transpose sweep (`Trans.TRANS`;
+Hermitian via the conjugation identity for complex) — `jax.grad`
+through a solve performs ZERO new factorizations, pinned by the obs
+health counter and the `autodiff.adjoint_solve` /
+`autodiff.reuses_resident` slulint contracts.  `d/dA` is the standard
+−x·λᵀ outer product restricted to the sparsity pattern: one gather
+over precomputed pattern indices, returned as a values-vector
+cotangent aligned with `A_values` (== `a.data` == plan.coo order).
+
+DESIGN.md §24 documents the adjoint math, the refined-forward vs
+exact-fixed-point VJP semantics, and the failure model.
+"""
+
+from .solve import (GradResult, grad_context, sparse_solve,
+                    vjp_solve)
+
+__all__ = ["GradResult", "grad_context", "sparse_solve", "vjp_solve"]

@@ -176,6 +176,13 @@ FLAGS: dict[str, str] = {
     "SLU_SERVE_OUT": "serve_bench output path (default SERVE_LATENCY.jsonl)",
     "SLU_SERVE_MIN_SPEEDUP": "serve_bench regression floor on batched-vs-sequential speedup (default 1.0 = never lose; timeshared-box noise)",
     "SLU_SERVE_MIXED": "1 = serve_bench mixed-dtype-traffic scenario: same matrix at two precision rungs (f64 native + f32/df64), alternating traffic, pinning ZERO recompiles across rungs on the obs compile counter",
+    # --- differentiable solve (autodiff/solve.py, bench.py --grad) ---
+    "SLU_AD_REFINE": "differentiable-forward refinement steps (default 1): sparse_solve returns the k-step refined solution while its VJP stays the exact-fixed-point adjoint (DESIGN.md §24); 0 = raw resident apply — the primal then carries NO A_values dependence (d/dA finite differences read 0 while the VJP still answers the implicit-function question)",
+    "SLU_AD_JIT": "1 (default) = dispatch the autodiff forward/adjoint legs through the cached compile-watched jits (obs phases grad_fwd/adjoint — the zero-recompile and HLO-contract surface); 0 = trace them op-by-op eager (debug lane)",
+    "SLU_GRAD_OUT": "bench.py --grad record path (default GRAD.jsonl): FD-oracle + adjoint/forward cost record under the promote discipline; a failed gate stamps measurement_invalid and persists nothing",
+    "SLU_GRAD_K": "bench.py --grad grid size (3D Laplacian, n=k^3; default 10)",
+    "SLU_GRAD_TRIALS": "bench.py --grad timing trials per leg (default 5; median is the measurement)",
+    "SLU_GRAD_RATIO_MAX": "bench.py --grad gate ceiling on the adjoint/forward median wall ratio (default 1.5 — the ISSUE-18 bar: the adjoint is one resident transpose sweep plus pattern gathers, the same program class as a forward solve)",
     # --- mesh-resident serving (serve/service.py, parallel/factor_dist.py, tools/, bench.py) ---
     "SLU_SERVE_MESH": "1 = mesh-resident serving: ServeConfig.mesh defaults to a device mesh (SLU_MESH_SHAPE), the factor cache factors through the shard_map'd dist backend, every request key carries an Options.mesh_shape leg, and factor_cost_hint_s resolves the 'dist' cost arm.  Off (default) = single-device serving, one env read of overhead at ServeConfig construction and at cost-hint resolution",
     "SLU_MESH_SHAPE": "mesh grid for SLU_SERVE_MESH=1 ('2x2x2', '8'; default: all local devices on one flat axis) — resolved once per ServeConfig construction, zero per-request overhead",
@@ -191,6 +198,7 @@ NON_FLAG_TOKENS: frozenset = frozenset({
     "SLU_DOUBLE",    # IterRefine enum member (options.py)
     "SLU_NC",        # reference SuperMatrix storage format name
     "SLU_COOP_",     # prefix shorthand in a batched.py comment
+    "SLU_AD_",       # prefix shorthand in autodiff/solve.py docstrings
     "SLU_",          # the bare prefix itself (docstrings)
 })
 

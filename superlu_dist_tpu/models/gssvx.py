@@ -318,6 +318,30 @@ def solve(lu: LUFactorization, b: np.ndarray,
     return x[:, 0] if squeeze else x
 
 
+def perm_scale_vectors(plan: FactorPlan, trans: Trans):
+    """The four vectors of solve()'s embedding algebra for one trans
+    lane, as plain numpy arrays: (in_scale, in_perm, out_perm,
+    out_scale) such that
+
+        x = out_scale · y[out_perm],   y = M_solve( (in_scale · b)[in_perm] )
+
+    with M = Pf_r·Dr·A·Dc·Pf_cᵀ (NOTRANS) or its transpose swap
+    (TRANS; CONJ callers conjugate around the TRANS lane).  `in_perm`
+    is the argsort inverse of the scatter solve() uses
+    (`out[final_row] = scaled` ⇔ `out = scaled[argsort(final_row)]`),
+    which is what makes the same algebra expressible as pure gathers
+    inside a jax trace — the autodiff fwd/adjoint legs
+    (superlu_dist_tpu/autodiff/solve.py) are built on exactly this."""
+    if trans == Trans.TRANS:
+        return (plan.col_scale, np.argsort(plan.final_col),
+                plan.final_row, plan.row_scale)
+    if trans == Trans.CONJ:
+        raise ValueError("CONJ has no direct embedding lane; "
+                         "conjugate around TRANS (see solve())")
+    return (plan.row_scale, np.argsort(plan.final_row),
+            plan.final_col, plan.col_scale)
+
+
 def solve_rhs_dtype(lu: LUFactorization) -> np.dtype:
     """The dtype a plain float64 RHS produces after the solve path's
     promote_types against the factors — the ONE definition of the

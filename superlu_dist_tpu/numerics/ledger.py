@@ -84,6 +84,22 @@ def stamp_perturbed(x: np.ndarray, ledger=None,
     return out
 
 
+def strip_result_markers(x):
+    """Plain base-class view of a possibly marker-stamped array.
+
+    PerturbedResult / serve.DegradedResult are zero-copy ndarray VIEW
+    subclasses; jax must never see the subclass — `jnp.asarray` of a
+    stamped array works, but a subclass leaking into `vmap`/`grad`
+    tracers (or riding a cotangent) would carry a stale ledger onto
+    arrays it does not describe.  The autodiff boundary
+    (autodiff/solve.py sparse_solve) strips here and re-stamps the
+    PRIMAL output only; cotangents always stay plain.  Non-ndarray
+    inputs (tracers, jnp arrays, lists) pass through untouched."""
+    if isinstance(x, np.ndarray) and type(x) is not np.ndarray:
+        return x.view(np.ndarray)
+    return x
+
+
 def build_ledger(lu) -> PerturbationLedger:
     """Ledger for a live factorization handle.  Reads the device
     tiny-pivot counter the factor kernels accumulated; only when it is

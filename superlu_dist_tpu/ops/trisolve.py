@@ -662,6 +662,21 @@ def sweep(ts: TrisolveSchedule, packs, b, dtype, trans: bool,
     return _dec(x, cplx)
 
 
+def resident_sweep(ts: TrisolveSchedule, packs, b, dtype,
+                   trans: bool, pair: bool = False):
+    """Pair-codec-aware merged sweep: takes/returns the caller's
+    complex b even for pair-stored factors (sweep's `pair=True`
+    contract is pre-encoded real-view planes).  The embedding entry
+    point the autodiff VJP legs ride (autodiff/solve.py) — both the
+    forward and the adjoint (trans=True) leg of a differentiable
+    solve are ONE call here against the same (ts, packs)."""
+    if pair:
+        from .batched import _dec, _enc
+        return _dec(sweep(ts, packs, _enc(jnp.asarray(b), True),
+                          dtype, trans, pair=True), True)
+    return sweep(ts, packs, b, dtype, trans, pair=False)
+
+
 # --------------------------------------------------------------------
 # packed FACTORED fast path (what the serve hot path dispatches)
 # --------------------------------------------------------------------

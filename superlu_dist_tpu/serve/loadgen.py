@@ -36,6 +36,7 @@ def run_load(service: SolveService, matrices, *,
              deadline_s: float | None = None,
              options=None,
              seed: int = 0,
+             grad_fraction: float = 0.0,
              join_timeout_s: float | None = None) -> dict:
     """Drive `requests` total solves through `service` from
     `concurrency` closed-loop workers; returns the report dict.
@@ -43,6 +44,12 @@ def run_load(service: SolveService, matrices, *,
     `matrices` is a list of (CSRMatrix | CacheKey); index 0 is the hot
     key.  Workers split the request count evenly (remainder to the
     first workers).
+
+    `grad_fraction` of requests go through service.grad_solve()
+    instead — the adjoint-under-load lane.  Their statuses land in
+    the same report prefixed `grad_` (its finite probe covers the
+    solution AND both cotangents), so a gate can pin e.g. zero
+    `grad_miss_failfast` alongside the solve mix.
 
     `join_timeout_s` bounds the wait for workers: the report's
     `unresolved` field counts requests that never produced a status —
@@ -87,11 +94,16 @@ def run_load(service: SolveService, matrices, *,
             # generator — a second inline except-chain here had
             # already drifted from it (StaleFactorError folded into
             # serve_error)
-            status, _x = _status_of_solve(
-                lambda: service.solve(matrices[mi], b,
-                                      options=options,
-                                      deadline_s=deadline_s,
-                                      info=info))
+            if grad_fraction > 0.0 and rng.random() < grad_fraction:
+                status, _x = _status_of_grad(
+                    lambda: service.grad_solve(matrices[mi], b,
+                                               options=options))
+            else:
+                status, _x = _status_of_solve(
+                    lambda: service.solve(matrices[mi], b,
+                                          options=options,
+                                          deadline_s=deadline_s,
+                                          info=info))
             with res_lock:
                 results.append((time.monotonic() - t0, status,
                                 info.get("request_id")))
@@ -174,6 +186,29 @@ def _status_of_solve(do_solve) -> tuple[str, object]:
     if isinstance(x, DegradedResult):
         return "degraded", x
     return "ok", x
+
+
+def _status_of_grad(do_grad) -> tuple[str, object]:
+    """One grad_solve through the SAME taxonomy, statuses prefixed
+    `grad_` so the report separates the adjoint lane from the solve
+    mix.  The finite probe covers the primal and BOTH cotangents — a
+    NaN that only reaches ct_vals must not read `grad_ok`."""
+    box: dict = {}
+
+    def run():
+        box["res"] = do_grad()
+        # placate the solve probe's ndarray checks — the GradResult's
+        # own three-leg finite probe runs below
+        return np.zeros(1)
+
+    status, _ = _status_of_solve(run)
+    if status != "ok":
+        return "grad_" + status, None
+    res = box["res"]
+    for leg in (res.x, res.ct_b, res.ct_vals):
+        if not np.all(np.isfinite(np.asarray(leg))):
+            return "grad_nonfinite", None
+    return "grad_ok", res
 
 
 def run_stream_load(streams, *, steps: int = 16,

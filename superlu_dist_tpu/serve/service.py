@@ -409,6 +409,60 @@ class SolveService:
         self._batcher_for(key, lu, options).warmup()
         return key
 
+    def grad_solve(self, a: CSRMatrix | CacheKey, b: np.ndarray,
+                   xbar=None, options: Options | None = None,
+                   A_values=None, trans=None):
+        """Differentiable solve + adjoint pull against the factor
+        cache (autodiff.vjp_solve): solve op(A)x = b on the resident
+        factors, then pull the loss direction `xbar` (default ones)
+        back through the custom VJP — ZERO new factorizations when
+        the key is warm.  `a` may be a CacheKey from prefactor()
+        (fail-fast FactorMissError when no longer resident — grad
+        never pays an implicit factorization on a keyed request) or
+        the matrix itself (resolved through the cache like solve()'s
+        factor policy).  Returns an autodiff.GradResult; the flight
+        record carries per-leg `grad.fwd` / `grad.adj` events and
+        errors map through the same outcome taxonomy as solves."""
+        from ..autodiff import vjp_solve
+        with self._lock:
+            if self._closed:
+                raise ServeError("service is closed")
+        rec = flight.start(kind="grad")
+        t0 = time.monotonic()
+        try:
+            self._validate_request(a, b)
+            if isinstance(a, CacheKey):
+                key = a
+                self.cache.note_demand(key)
+                lu = self.cache.get(key)
+                if lu is None:
+                    self.metrics.inc("serve.miss_failfast")
+                    raise FactorMissError(
+                        "keyed grad_solve for a key no longer "
+                        "resident; prefactor() it again")
+            else:
+                options = self._stamp_mesh(options or Options())
+                key = matrix_key(a, options)
+                self.cache.note_demand(key)
+                lu = self.cache.get_or_factorize(a, options, key=key)
+                if A_values is None:
+                    A_values = a.data
+            self._note_route(rec, lu, served="grad")
+            flight.set_current(rec)
+            try:
+                res = vjp_solve(lu, b, xbar=xbar, A_values=A_values,
+                                trans=trans)
+            finally:
+                flight.set_current(None)
+        except BaseException as e:
+            self.metrics.inc("serve.grad_errors")
+            self._abort_request(rec, t0, e)
+            raise
+        self.metrics.inc("serve.grad_solves")
+        if rec is not None:
+            rec.finish("ok", e2e_s=time.monotonic() - t0)
+        return res
+
     def stream(self, a: CSRMatrix, options: Options | None = None,
                config=None):
         """Open a matrix STREAM on `a`'s pattern (stream/pipeline.py):

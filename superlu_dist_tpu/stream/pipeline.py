@@ -249,6 +249,26 @@ class StreamHandle:
             None, b, options, deadline_s, info=info,
             _router=functools.partial(self._route_stream, tk))
 
+    def grad_solve(self, b: np.ndarray, xbar=None, trans=None):
+        """Differentiable solve + adjoint pull on the RESIDENT
+        generation (autodiff.vjp_solve): the gradient rides the
+        generation's factors at ITS linearization point — `g.a`, the
+        matrix those factors came from, not the drifted live values,
+        because the grad of a stale generation is the grad of the
+        system it actually solves.  Returns (GradResult, gen) so the
+        caller can pin which generation the cotangents belong to
+        across a concurrent swap; FactorMissError when nothing is
+        resident (closed or never primed)."""
+        from ..autodiff import vjp_solve
+        g = self.swap.current
+        if g is None:
+            raise FactorMissError(
+                "stream has no resident generation to differentiate "
+                "through")
+        res = vjp_solve(g.lu, b, xbar=xbar, A_values=g.a.data,
+                        trans=trans)
+        return res, g.gen
+
     def refactor_now(self) -> None:
         """Force a background refactorization of the live values
         (cadence bypassed) — the operator's manual lever.  Works on a

@@ -1,0 +1,364 @@
+"""autodiff/: the differentiable sparse solve (ISSUE 18).
+
+FD oracles at fp64 (central differences, rtol 1e-6) for d/db and
+d/dA across trans lanes and RHS counts; complex lanes against the
+dense jnp.linalg.solve vjp; vmap composition; the zero-factorization
+and zero-recompile pins; the serve/stream grad entry points; the
+marker strip/re-stamp boundary; and the two slulint HLO contracts."""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import (CSRMatrix, Options, factorize, obs,
+                              sparse_solve, vjp_solve)
+from superlu_dist_tpu.autodiff import GradResult, grad_context
+from superlu_dist_tpu.numerics.errors import InvalidInputError
+from superlu_dist_tpu.numerics.ledger import (PerturbationLedger,
+                                              PerturbedResult,
+                                              stamp_perturbed,
+                                              strip_result_markers)
+from superlu_dist_tpu.obs import flight
+from superlu_dist_tpu.options import Trans
+from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+
+@pytest.fixture(autouse=True)
+def _flight_off():
+    flight.configure(enabled=False)
+    yield
+    flight.configure(enabled=False)
+
+
+def _f64_lu(k=4):
+    a = laplacian_3d(k)
+    lu = factorize(a, Options(factor_dtype="float64"), backend="jax")
+    return a, lu
+
+
+def _fd_loss(loss, args, argnum, idx, eps=1e-6):
+    """Central finite difference of `loss` in args[argnum][idx]."""
+    up = [np.asarray(a).copy() for a in args]
+    dn = [np.asarray(a).copy() for a in args]
+    up[argnum][idx] += eps
+    dn[argnum][idx] -= eps
+    return (float(loss(*map(jnp.asarray, up)))
+            - float(loss(*map(jnp.asarray, dn)))) / (2 * eps)
+
+
+# --------------------------------------------------------------------
+# FD oracles (fp64, rtol 1e-6) — d/db, d/dA, trans lanes, nrhs
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("lane", [Trans.NOTRANS, Trans.TRANS])
+def test_grad_matches_central_fd(lane):
+    a, lu = _f64_lu()
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(a.n)
+    vals = jnp.asarray(a.data)
+    w = jnp.asarray(rng.standard_normal(a.n))
+
+    def loss(v, bb):
+        return (w * sparse_solve(v, bb, lu, trans=lane)).sum()
+
+    gv, gb = jax.grad(loss, argnums=(0, 1))(vals, jnp.asarray(b))
+    for i in (0, 7, a.n - 1):
+        fd = _fd_loss(loss, (vals, b), 1, i)
+        assert abs(float(gb[i]) - fd) <= 1e-6 * max(1.0, abs(fd))
+    for s in (0, 23, len(a.data) - 1):
+        fd = _fd_loss(loss, (vals, b), 0, s)
+        assert abs(float(gv[s]) - fd) <= 1e-6 * max(1.0, abs(fd))
+
+
+def test_multirhs_grad_matches_fd():
+    a, lu = _f64_lu()
+    rng = np.random.default_rng(1)
+    B = rng.standard_normal((a.n, 3))
+    vals = jnp.asarray(a.data)
+    w = jnp.asarray(rng.standard_normal((a.n, 3)))
+
+    def loss(v, bb):
+        return (w * sparse_solve(v, bb, lu)).sum()
+
+    gv, gb = jax.grad(loss, argnums=(0, 1))(vals, jnp.asarray(B))
+    assert gb.shape == B.shape
+    for idx in ((0, 0), (5, 2)):
+        fd = _fd_loss(loss, (vals, B), 1, idx)
+        assert abs(float(gb[idx]) - fd) <= 1e-6 * max(1.0, abs(fd))
+    for s in (11, 40):
+        fd = _fd_loss(loss, (vals, B), 0, s)
+        assert abs(float(gv[s]) - fd) <= 1e-6 * max(1.0, abs(fd))
+
+
+def test_complex_lanes_match_dense_vjp():
+    """TRANS and CONJ are distinct for complex matrices; every lane's
+    vjp must match the dense jnp.linalg.solve reference exactly (same
+    JAX convention, same program semantics)."""
+    a3 = laplacian_3d(3)
+    rng = np.random.default_rng(2)
+    data = (a3.data.astype(np.complex128)
+            + 1j * 0.1 * rng.standard_normal(len(a3.data)))
+    ac = CSRMatrix(a3.m, a3.n, a3.indptr, a3.indices, data)
+    lu = factorize(ac, Options(factor_dtype="complex128"),
+                   backend="jax")
+    b = (rng.standard_normal(ac.n) + 1j * rng.standard_normal(ac.n))
+    vc = jnp.asarray(ac.data)
+    rows, cols, _ = ac.to_coo()
+    rows_j, cols_j = jnp.asarray(rows), jnp.asarray(cols)
+
+    def dense(lane):
+        def f(v, bb):
+            A = jnp.zeros((ac.n, ac.n), v.dtype).at[
+                rows_j, cols_j].set(v)
+            M = {Trans.NOTRANS: A, Trans.TRANS: A.T,
+                 Trans.CONJ: A.conj().T}[lane]
+            return jnp.linalg.solve(M, bb)
+        return f
+
+    ct = jnp.asarray(rng.standard_normal(ac.n)
+                     + 1j * rng.standard_normal(ac.n))
+    for lane in (Trans.NOTRANS, Trans.TRANS, Trans.CONJ):
+        f_s = lambda v, bb: sparse_solve(v, bb, lu, trans=lane)  # noqa: E731
+        x_s, pull_s = jax.vjp(f_s, vc, jnp.asarray(b))
+        x_d, pull_d = jax.vjp(dense(lane), vc, jnp.asarray(b))
+        assert np.abs(np.asarray(x_s) - np.asarray(x_d)).max() < 1e-9
+        cs, cd = pull_s(ct), pull_d(ct)
+        assert np.abs(np.asarray(cs[0])
+                      - np.asarray(cd[0])).max() < 1e-8
+        assert np.abs(np.asarray(cs[1])
+                      - np.asarray(cd[1])).max() < 1e-8
+
+
+def test_vmap_batched_grads_match_per_sample():
+    """jax.vmap over batched value arrays AND batched RHS composes
+    with the custom VJP; the vmapped gradients equal the per-sample
+    calls of the same function."""
+    a, lu = _f64_lu()
+    rng = np.random.default_rng(3)
+    B = 3
+    vals_b = jnp.asarray(
+        a.data[None, :]
+        * (1.0 + 1e-3 * rng.standard_normal((B, len(a.data)))))
+    bs = jnp.asarray(rng.standard_normal((B, a.n)))
+    w = jnp.asarray(rng.standard_normal(a.n))
+
+    def loss(v, bb):
+        return (w * sparse_solve(v, bb, lu)).sum()
+
+    g = jax.vmap(jax.grad(loss, argnums=(0, 1)))(vals_b, bs)
+    for i in range(B):
+        gv_i, gb_i = jax.grad(loss, argnums=(0, 1))(vals_b[i], bs[i])
+        np.testing.assert_allclose(np.asarray(g[0][i]),
+                                   np.asarray(gv_i), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(g[1][i]),
+                                   np.asarray(gb_i), rtol=1e-12)
+
+
+# --------------------------------------------------------------------
+# the resident pins: zero factorizations, zero recompiles
+# --------------------------------------------------------------------
+
+def test_grad_performs_zero_factorizations():
+    a, lu = _f64_lu()
+    vals = jnp.asarray(a.data)
+    b = jnp.ones((a.n,), vals.dtype)
+    fn = jax.grad(lambda v, bb: sparse_solve(v, bb, lu).sum(),
+                  argnums=(0, 1))
+    jax.block_until_ready(fn(vals, b))        # compile + run
+    before = obs.HEALTH.factorizations
+    jax.block_until_ready(fn(vals, 2.0 * b))
+    assert obs.HEALTH.factorizations == before
+
+
+def test_jit_grad_second_call_recompiles_nothing():
+    a, lu = _f64_lu()
+    vals = jnp.asarray(a.data)
+    b = jnp.ones((a.n,), vals.dtype)
+    fn = jax.grad(lambda v, bb: sparse_solve(v, bb, lu).sum(),
+                  argnums=(0, 1))
+    jax.block_until_ready(fn(vals, b))        # warm every leg
+    before = obs.COMPILE_WATCH.misses()
+    jax.block_until_ready(fn(vals, 3.0 * b))
+    assert obs.COMPILE_WATCH.misses() == before
+
+
+def test_vjp_solve_returns_gradresult_and_defaults():
+    a, lu = _f64_lu()
+    b = np.ones(a.n)
+    res = vjp_solve(lu, b)
+    assert isinstance(res, GradResult)
+    assert res.trans == Trans.NOTRANS
+    assert np.asarray(res.ct_b).shape == (a.n,)
+    assert np.asarray(res.ct_vals).shape == (len(a.data),)
+    # default xbar = ones: ct_b is the adjoint solve of ones
+    gb = jax.grad(lambda bb: sparse_solve(
+        jnp.asarray(a.data), bb, lu).sum())(jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(res.ct_b), np.asarray(gb),
+                               rtol=1e-12)
+
+
+def test_host_backend_refused_typed():
+    a = laplacian_3d(3)
+    lu = factorize(a, Options(), backend="host")
+    with pytest.raises(InvalidInputError):
+        sparse_solve(jnp.asarray(a.data), jnp.ones(a.n), lu)
+
+
+# --------------------------------------------------------------------
+# marker discipline at the autodiff boundary
+# --------------------------------------------------------------------
+
+def test_markers_stripped_from_inputs_and_cotangents():
+    a, lu = _f64_lu()
+    b = stamp_perturbed(np.ones(a.n),
+                        ledger=PerturbationLedger(1, 1e-8))
+    vals = stamp_perturbed(np.asarray(a.data),
+                           ledger=PerturbationLedger(1, 1e-8))
+    assert strip_result_markers(b).__class__ is np.ndarray
+    x = sparse_solve(vals, b, lu)
+    # clean factors: the primal comes back UNstamped
+    assert not isinstance(x, PerturbedResult)
+    gv, gb = jax.grad(
+        lambda v, bb: sparse_solve(v, bb, lu).sum(),
+        argnums=(0, 1))(jnp.asarray(vals), jnp.asarray(b))
+    # cotangents are never marker-stamped
+    assert not isinstance(np.asarray(gv), PerturbedResult)
+    assert not isinstance(np.asarray(gb), PerturbedResult)
+
+
+def test_perturbed_factors_restamp_primal_only():
+    from superlu_dist_tpu.autodiff.solve import _restamp_primal
+    led = PerturbationLedger(count=2, threshold=1e-8)
+    fake_lu = types.SimpleNamespace(ledger=led, rcond=0.25)
+    x = _restamp_primal(np.ones(4), fake_lu)
+    assert isinstance(x, PerturbedResult)
+    assert x.ledger is led and x.rcond == 0.25
+    clean = types.SimpleNamespace(ledger=None, rcond=None)
+    assert not isinstance(_restamp_primal(np.ones(4), clean),
+                          PerturbedResult)
+
+
+# --------------------------------------------------------------------
+# serve + stream grad entry points
+# --------------------------------------------------------------------
+
+def _jax_service():
+    from superlu_dist_tpu.serve import (Metrics, ServeConfig,
+                                        SolveService)
+    return SolveService(ServeConfig(backend="jax"),
+                        metrics=Metrics())
+
+
+def test_grad_under_serve_zero_factorizations():
+    from superlu_dist_tpu.serve import run_load
+    svc = _jax_service()
+    try:
+        a = laplacian_3d(4)
+        key = svc.prefactor(a, Options(factor_dtype="float64"))
+        b = np.ones(a.n)
+        # warm the grad legs once, then pin: zero factorizations
+        res = svc.grad_solve(key, b)
+        assert isinstance(res, GradResult)
+        before = obs.HEALTH.factorizations
+        res = svc.grad_solve(key, 2.0 * b)
+        assert obs.HEALTH.factorizations == before
+        assert np.isfinite(np.asarray(res.ct_vals)).all()
+        assert svc.metrics.counter("serve.grad_solves") == 2
+        # the adjoint-under-load lane: every request grad_ok
+        report = run_load(svc, [key], requests=8, concurrency=2,
+                          grad_fraction=1.0, seed=5)
+        assert report["by_status"] == {"grad_ok": 8}
+        assert report["unresolved"] == 0
+    finally:
+        svc.close()
+
+
+def test_grad_solve_cold_key_fails_fast_typed():
+    from superlu_dist_tpu.serve import FactorMissError
+    from superlu_dist_tpu.serve.factor_cache import CacheKey
+    svc = _jax_service()
+    try:
+        cold = CacheKey(pattern="0" * 40, values="0" * 40,
+                        options=())
+        with pytest.raises(FactorMissError):
+            svc.grad_solve(cold, np.ones(8))
+        assert svc.metrics.counter("serve.grad_errors") == 1
+    finally:
+        svc.close()
+
+
+def test_grad_solve_flight_record_carries_both_legs():
+    flight.configure(enabled=True)
+    svc = _jax_service()
+    try:
+        a = laplacian_3d(3)
+        key = svc.prefactor(a, Options(factor_dtype="float64"))
+        svc.grad_solve(key, np.ones(a.n))
+        svc.drain_observability()
+        rec = flight.get_recorder().records()[-1]
+        assert rec["outcome"] == "ok"
+        assert rec["meta"]["kind"] == "grad"
+        stages = [e["stage"] for e in rec["events"]]
+        assert "grad.fwd" in stages and "grad.adj" in stages
+    finally:
+        svc.close()
+
+
+def test_grad_through_stream_rides_the_resident_generation():
+    import dataclasses
+    from superlu_dist_tpu.stream import StreamConfig
+    svc = _jax_service()
+    try:
+        a = laplacian_3d(4)
+        h = svc.stream(a, Options(factor_dtype="float64"),
+                       StreamConfig(background=False))
+        b = np.ones(a.n)
+        res, gen = h.grad_solve(b)
+        assert gen == 1 and isinstance(res, GradResult)
+        # drift the live values: the resident generation (and its
+        # linearization point) is UNCHANGED until a refactor, so the
+        # grad must be bit-identical to the pre-drift one
+        a2 = dataclasses.replace(a, data=a.data * 1.001)
+        h.update(a2)
+        before = obs.HEALTH.factorizations
+        res2, gen2 = h.grad_solve(b)
+        assert gen2 == 1
+        assert obs.HEALTH.factorizations == before
+        np.testing.assert_array_equal(np.asarray(res.ct_vals),
+                                      np.asarray(res2.ct_vals))
+        h.close()
+    finally:
+        svc.close()
+
+
+def test_stream_grad_without_generation_fails_typed():
+    from superlu_dist_tpu.serve import FactorMissError
+    from superlu_dist_tpu.stream import StreamConfig
+    svc = _jax_service()
+    try:
+        a = laplacian_3d(3)
+        h = svc.stream(a, Options(factor_dtype="float64"),
+                       StreamConfig(background=False))
+        h.swap._current = None      # simulate nothing resident
+        with pytest.raises(FactorMissError):
+            h.grad_solve(np.ones(a.n))
+        h.close()
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------
+# HLO contracts (tools/slulint)
+# --------------------------------------------------------------------
+
+def test_adjoint_program_contract_holds():
+    from tools.slulint.contracts import assert_contract
+    assert_contract("autodiff.adjoint_solve")
+
+
+def test_reuses_resident_contract_holds():
+    from tools.slulint.contracts import assert_contract
+    assert_contract("autodiff.reuses_resident")

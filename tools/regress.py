@@ -45,6 +45,11 @@ tolerance:
                  --multichip-serve, MULTICHIP_r*.json): solves/s
                  floor, p99 ceiling, recompiles == 0,
                  bitwise_vs_mesh_oracle == True, gate.passed
+  * grad       — differentiable-solve gate (bench.py --grad,
+                 GRAD.jsonl): factorizations == 0 under jax.grad
+                 (the adjoint rides the resident factors), the
+                 adjoint/forward wall ratio within its ceiling,
+                 gate.passed (FD oracle + zero-recompile)
   * bench      — GFLOP/s floor
 
 Usage:
@@ -94,6 +99,9 @@ DEFAULT_TOLERANCES = {
     # stream drill: steady-state p99 of the background-refactor arm
     # over the pinned arm (the ISSUE-13 overlap acceptance)
     "stream_overlap_ratio": 1.10,
+    # grad gate: adjoint leg wall over forward leg wall on the SAME
+    # resident handle (the ISSUE-18 adjoint-cost acceptance)
+    "grad_adjoint_ratio": 1.5,
 }
 
 
@@ -212,6 +220,9 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "GAUNTLET.jsonl")):
         if rec.get("mode") == "gauntlet":
             add(rec.get("platform"), "gauntlet", rec)
+    for rec in _read_jsonl(os.path.join(root, "GRAD.jsonl")):
+        if rec.get("mode") == "grad":
+            add(rec.get("platform"), "grad", rec)
     for path in sorted(glob.glob(os.path.join(root,
                                               "MULTICHIP_r*.json"))):
         # mesh-resident serving A/B records (bench.py
@@ -570,6 +581,33 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the multichip serve A/B gate "
                     "itself failed"))
+            elif chk == "grad":
+                zero_check(p, chk, "factorizations",
+                           _num(latest, "factorizations"),
+                           "jax.grad paid a NEW factorization — the "
+                           "adjoint stopped riding the resident "
+                           "factors")
+                v = _num(latest, "adjoint_over_forward")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "adjoint_over_forward", None, None,
+                        None, "skip", "metric absent"))
+                else:
+                    limit = tol["grad_adjoint_ratio"]
+                    ok = v <= limit
+                    findings.append(_finding(
+                        p, chk, "adjoint_over_forward", v, 1.0, limit,
+                        "ok" if ok else "fail",
+                        "" if ok else "the adjoint leg costs more "
+                        "than its declared multiple of the forward "
+                        "solve on the same handle"))
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the grad gate itself failed (FD "
+                    "oracle, recompile, or ratio)"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -636,6 +674,9 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                 dst[chk] = {}          # structural zero-gates only
             elif chk == "gauntlet":
                 dst[chk] = {}          # structural zero-gates only
+            elif chk == "grad":
+                dst[chk] = {}          # structural gates only: the
+                                       # ratio ceiling is a tolerance
             elif chk == "multichip":
                 dst[chk] = {
                     m: _median([v for r in win

@@ -138,6 +138,7 @@ CONTRACT_MODULES = (
     "superlu_dist_tpu.precision.doubleword",
     "superlu_dist_tpu.numerics.gscon",
     "superlu_dist_tpu.parallel.factor_dist",
+    "superlu_dist_tpu.autodiff.solve",
 )
 
 

@@ -147,6 +147,17 @@ SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --gauntlet \
   >> "$log" 2>&1
 stamp "gauntlet rc=$?"
 
+# 3d. Differentiable-solve gate (ISSUE 18): FD oracle on d/dA and
+#     d/db, zero new factorizations under jax.grad, zero recompiles
+#     on the second call, adjoint/forward wall ratio ceiling —
+#     bench.py --grad appends ONE gated record to GRAD.jsonl and
+#     FAILS persisting nothing on any miss.  One small f64 system —
+#     runs in the dryrun too; SLU_REGRESS=0 like 3b/3c (the full
+#     sentinel at the end gates the committed record).
+SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --grad \
+  >> "$log" 2>&1
+stamp "grad gate rc=$?"
+
 # 4e. Mesh-resident serving A/B (ISSUE 17): one-device vs mesh
 #     replica on the same key set through the batcher bucket ladder —
 #     bench.py --multichip-serve writes ONE gated record
