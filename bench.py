@@ -1157,6 +1157,93 @@ def _grad():
         f.write(json.dumps(rec) + "\n")
 
 
+def _plan_latency():
+    """`bench.py --plan-latency`: the ROADMAP 5a record (ISSUE 19).
+
+    Times the COLD symbolic pipeline across the standard 3D-Laplacian
+    ladder (SLU_PLAN_LATENCY_KS, default 8,12,16,20): plan-build
+    (plan_factorization — equilibrate/orderings/symbolic) and
+    schedule-build (ops/batched.build_schedule) walls per n, each
+    record carrying the pattern sha1, nnz, and the analytic
+    plan_bytes_predicted (obs/memory.py) for the n>=1e6 capacity
+    story.  One mode="plan_latency" line per n appends to
+    SLU_PLAN_LATENCY_OUT (default PLAN_LATENCY.jsonl), gated by
+    tools/regress.py (per-(platform, n) wall ceilings).
+
+    Promote discipline (the --factor-ab convention): a non-finite or
+    non-positive wall stamps the round measurement_invalid, persists
+    NOTHING, and exits 1."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import jax
+    dev = jax.devices()[0]
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.obs.memory import schedule_bytes_predicted
+    from superlu_dist_tpu.ops.batched import build_schedule
+    from superlu_dist_tpu.plan.plan import (pattern_sha1,
+                                            plan_factorization)
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    ks = [int(s) for s in os.environ.get(
+        "SLU_PLAN_LATENCY_KS", "8,12,16,20").split(",") if s.strip()]
+    opts = Options(factor_dtype="float64")
+    out_path = os.environ.get(
+        "SLU_PLAN_LATENCY_OUT", os.path.join(repo,
+                                             "PLAN_LATENCY.jsonl"))
+
+    recs = []
+    ok = True
+    for k in ks:
+        a = laplacian_3d(k)
+        t0 = time.perf_counter()
+        plan = plan_factorization(a, opts)
+        t_plan = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sched = build_schedule(plan, ndev=1)
+        t_sched = time.perf_counter() - t0
+        rec = {
+            "mode": "plan_latency", "source": "bench",
+            "n": int(a.n), "nnz": int(a.nnz), "k": int(k),
+            "pattern_sha1": pattern_sha1(a),
+            "t_plan_s": round(t_plan, 6),
+            "t_schedule_s": round(t_sched, 6),
+            "plan_bytes_predicted": int(
+                schedule_bytes_predicted(sched, "float64")),
+            "lu_nnz": int(plan.lu_nnz()),
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        good = (np.isfinite(t_plan) and t_plan > 0
+                and np.isfinite(t_sched) and t_sched > 0)
+        rec["gate"] = {"passed": bool(good)}
+        if not good:
+            rec["measurement_invalid"] = True
+            ok = False
+        recs.append(rec)
+        print(json.dumps(rec))
+        print(f"# plan-latency n={a.n}: plan {t_plan*1e3:.1f} ms, "
+              f"schedule {t_sched*1e3:.1f} ms", file=sys.stderr)
+    if not ok:
+        print("# PLAN LATENCY GATE FAILURE; records not persisted",
+              file=sys.stderr)
+        raise SystemExit(1)
+    with open(out_path, "a") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    if os.environ.get("SLU_REGRESS", "1") != "0":
+        from tools import regress
+        findings, passed = regress.check_repo(repo)
+        print(regress.format_findings(findings), file=sys.stderr)
+        if not passed:
+            raise SystemExit(1)
+
+
 def _multichip_serve():
     """`bench.py --multichip-serve`: the mesh-resident serving A/B
     (ISSUE 17).
@@ -1453,6 +1540,13 @@ def main():
         # second call, adjoint/forward wall ratio ceiling; appends
         # to GRAD.jsonl, gated by tools/regress.py
         _grad()
+        return
+    if "--plan-latency" in sys.argv[1:]:
+        # symbolic-pipeline latency ladder (ROADMAP 5a / ISSUE 19):
+        # cold plan-build + schedule-build walls per n, with pattern
+        # sha1 and the analytic bytes prediction; appends to
+        # PLAN_LATENCY.jsonl, gated by tools/regress.py
+        _plan_latency()
         return
     if "--multichip-serve" in sys.argv[1:]:
         # mesh-resident serving A/B (ISSUE 17): one-device vs mesh

@@ -76,6 +76,15 @@ FLAGS: dict[str, str] = {
     "SLU_SLO": "SLO declaration: '1' = defaults (p99_ms=100, avail=0.99, window_s=60); 'p99_ms=50,avail=0.999,window_s=60[;scope:field=v]' with n-bucket/dtype-tier scoped overrides; sliding-window burn-rate accounting per (n-bucket, dtype tier) with exemplar rids on violated windows; off = one pointer check per request completion",
     "SLU_FLIGHT_AB_TRIALS": "serve_bench --flight-ab interleaved trial-pair count (default 5; median per arm is the measurement)",
     "SLU_FLIGHT_MAX_OVERHEAD": "serve_bench --flight-ab failure threshold on flight-on vs flight-off throughput loss (default 0.05 — the ISSUE-8 overhead acceptance)",
+    # --- fleet telemetry export + aggregation (obs/export.py, obs/aggregate.py, obs/memory.py) ---
+    "SLU_OBS_EXPORT": "telemetry export listener address ('unix:/path/sock', 'host:port', or a bare port on 127.0.0.1): serves the versioned obs snapshot as JSON (/snapshot) and Prometheus-style text (/metrics) over a minimal HTTP loop; unset/0 (default) = no listener, and the serve path pays ONE module-global pointer check (nothing per request — export reads snapshots on its own threads)",
+    "SLU_OBS_EXPORT_JSONL": "periodic export write-through path: one schema-stamped snapshot line per period appended beside the durable store (tracer sink discipline: self-disables on I/O error, never throws into serving); implies the exporter is on even without a listener",
+    "SLU_OBS_EXPORT_PERIOD_S": "export write-through period in seconds (default 5.0); each tick costs one registry snapshot + one file append on the exporter's own thread",
+    "SLU_OBS_MEM": "1 = live device-memory probes (jax device.memory_stats live/peak bytes) on every factorization's watermark record; off (default) = the analytic slab-extent bytes model only (free: a few int multiplies from the schedule), so every factorization record still carries plan_bytes_predicted",
+    "SLU_PLAN_LATENCY_OUT": "plan-build latency record sink (ROADMAP 5a): plan/plan.py appends one mode=plan_latency line (t_plan_s, pattern sha1, n, nnz) per cold plan build when set; bench.py --plan-latency writes its gated ladder records here too (default PLAN_LATENCY.jsonl); self-disabling sink, one file append per plan build",
+    "SLU_PLAN_LATENCY_KS": "bench.py --plan-latency grid-size ladder, comma-separated laplacian_3d ks (default 8,12,16,20 — n 512..8000); each k is one cold plan-build + schedule-build timing record",
+    "SLU_EXPORT_AB_TRIALS": "serve_bench --export-ab interleaved trial-pair count (default 5; median per arm is the measurement)",
+    "SLU_EXPORT_MAX_OVERHEAD": "serve_bench --export-ab failure threshold on export-on vs export-off throughput loss (default 0.05 — the ISSUE-19 acceptance, same bar as flight-ab)",
     "SLU_REGRESS": "0 = skip the perf-regression sentinel gate serve_bench runs after appending its record (tools/regress.py vs BASELINES.json; default on)",
     # --- mixed precision (precision/, options.py, serve/service.py) ---
     "SLU_PREC_RESIDUAL": "auto|plain|doubleword|fp64 default Options.residual_mode: how the IR residual accumulates (doubleword = two-float fp32 df64, ~25 f32 flops/term vs 2 — noise next to fp64 EMULATION on TPU, and zero f64 ops in the jitted path; host loop uses native f64 either way)",

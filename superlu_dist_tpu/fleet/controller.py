@@ -152,3 +152,63 @@ def signals_from(service, ring=None, replicas=(),
     return FleetSignals(burn=burn, replicas=tuple(replicas),
                         popularity=tuple(popularity),
                         breaker_by_state=by_state)
+
+
+def signals_from_snapshots(snapshots, key_home=None, replicas=(),
+                           top: int = 16, now: float | None = None,
+                           stale_s: float | None = None,
+                           metrics=None) -> FleetSignals:
+    """Build FleetSignals SOLELY from exported remote snapshots
+    (obs/export.py export_snapshot records) — the fleet control
+    room's gather path (ISSUE 19): no in-process SolveService needed.
+
+    `snapshots` is a mapping replica-name -> snapshot dict (None for
+    a fetch that failed) or a bare iterable of snapshots.  Torn,
+    stale, missing and duplicate inputs are tolerated per
+    obs/aggregate.merge; every fetch failure lands in the
+    gather-containment counter ("controller.gather_failures" on
+    `metrics`) and is stamped inf in `snapshot_stale_s` — the signal
+    the policy (and the drill's gates) can see, never a crash.
+    `key_home(key_i)` resolves a merged demand key to its ring home
+    (the drill passes its ring join; None leaves homes blank)."""
+    from ..obs import aggregate
+
+    now = time.time() if now is None else float(now)
+    if not isinstance(snapshots, dict):
+        named = {}
+        for snap in snapshots:
+            name = (snap.get("replica")
+                    if aggregate.is_export_snapshot(snap)
+                    else f"?{len(named)}")
+            named[name] = snap
+        snapshots = named
+    fleet = aggregate.merge(
+        snapshots.values(), now=now,
+        stale_s=(aggregate.DEFAULT_STALE_S if stale_s is None
+                 else stale_s))
+    stale: dict = {}
+    failures = 0
+    for name, snap in snapshots.items():
+        if not aggregate.is_export_snapshot(snap):
+            stale[name] = float("inf")
+            failures += 1
+            continue
+        ts = snap.get("ts")
+        stale[name] = (max(0.0, now - float(ts))
+                       if isinstance(ts, (int, float))
+                       else float("inf"))
+    if metrics is not None and failures:
+        metrics.inc("controller.gather_failures", failures)
+    popularity = []
+    for ent in fleet["popularity"][:top]:
+        home = key_home(ent["key_i"]) if key_home is not None else ""
+        # "key" aliases the merged key_i so FleetPolicy.decide (which
+        # reads ent["key"]) sees the same shape signals_from builds
+        popularity.append({**ent, "key": ent["key_i"], "home": home})
+    return FleetSignals(
+        burn=fleet["burn_max"],
+        replicas=tuple(replicas) if replicas
+        else tuple(snapshots.keys()),
+        popularity=tuple(popularity),
+        breaker_by_state=fleet["breaker_by_state"],
+        snapshot_stale_s=stale)

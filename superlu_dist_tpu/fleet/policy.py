@@ -86,6 +86,11 @@ class FleetSignals:
     replicas: tuple = ()
     popularity: tuple = ()
     breaker_by_state: dict = dataclasses.field(default_factory=dict)
+    # per-replica snapshot age in seconds when the gather was fed
+    # from exported remote snapshots (obs/aggregate.py): inf marks a
+    # replica whose snapshot fetch FAILED this tick (torn/missing) —
+    # stamped, never a crash (ISSUE 19)
+    snapshot_stale_s: dict = dataclasses.field(default_factory=dict)
 
 
 # -- actions (what decide() returns) ----------------------------------

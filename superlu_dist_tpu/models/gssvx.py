@@ -194,15 +194,25 @@ def factorize(a: CSRMatrix, options: Options | None = None,
     from ..numerics.ledger import build_ledger
     src = lu.host_lu if lu.backend == "host" else lu.device_lu
     lu.ledger = build_ledger(lu)
+    # device-memory watermarks (obs/memory.py, ISSUE 19): the
+    # predicted/measured byte pair of THIS factorization rides the
+    # Stats, the health ring, and the MEMWATCH registry provider —
+    # analytic slab-extent bytes always, live device.memory_stats()
+    # under SLU_OBS_MEM=1
+    from ..obs import memory as obs_memory
+    mem = obs_memory.watermarks(lu, phase=_phase)
+    stats.mem_watermarks = mem
     obs.HEALTH.record_factor(
         tiny_pivots=int(getattr(src, "tiny_pivots", 0)),
         pivot_growth=(obs.pivot_growth(lu) if obs.enabled() else None),
         dtype=options.factor_dtype,
         perturbation=(lu.ledger.to_dict() if lu.ledger.perturbed
-                      else None))
+                      else None),
+        mem=mem)
     stats.note_factor_event(tiny_pivots=int(getattr(src, "tiny_pivots",
                                                     0)),
-                            dtype=options.factor_dtype)
+                            dtype=options.factor_dtype,
+                            mem=mem)
     return lu
 
 

@@ -37,11 +37,15 @@ Prometheus-style text dump (wired into `SolveService` and
 `bench.py --serve`).
 """
 
-from . import flight, slo
+from . import aggregate, export, flight, memory, slo
+from .aggregate import FLEET_SCHEMA, FLEET_VERSION
 from .compile_watch import (COMPILE_WATCH, CompileWatch, stamp_cost,
                             take_cost, watch_jit)
+from .export import (EXPORT_SCHEMA, EXPORT_VERSION, export_enabled,
+                     export_snapshot, export_text)
 from .flight import FlightRecord, FlightRecorder
 from .health import HEALTH, HealthMonitor, pivot_growth
+from .memory import MEMWATCH, MemoryWatch
 from .registry import REGISTRY, Registry
 from .slo import Objective, SloEngine
 from .tracer import (NULL_SPAN, Tracer, complete, configure, enabled,
@@ -49,12 +53,15 @@ from .tracer import (NULL_SPAN, Tracer, complete, configure, enabled,
                      resolve_trace_path, span)
 
 __all__ = [
-    "COMPILE_WATCH", "CompileWatch", "FlightRecord", "FlightRecorder",
-    "HEALTH", "HealthMonitor", "NULL_SPAN", "Objective", "REGISTRY",
-    "Registry", "SloEngine", "Tracer", "complete", "configure",
-    "dump_text", "enabled", "export_trace", "flight", "get_tracer",
-    "instant", "pivot_growth", "resolve_trace_path", "slo",
-    "snapshot", "span", "stamp_cost", "take_cost", "watch_jit",
+    "COMPILE_WATCH", "CompileWatch", "EXPORT_SCHEMA", "EXPORT_VERSION",
+    "FLEET_SCHEMA", "FLEET_VERSION", "FlightRecord", "FlightRecorder",
+    "HEALTH", "HealthMonitor", "MEMWATCH", "MemoryWatch", "NULL_SPAN",
+    "Objective", "REGISTRY", "Registry", "SloEngine", "Tracer",
+    "aggregate", "complete", "configure", "dump_text", "enabled",
+    "export", "export_enabled", "export_snapshot", "export_text",
+    "export_trace", "flight", "get_tracer", "instant", "memory",
+    "pivot_growth", "resolve_trace_path", "slo", "snapshot", "span",
+    "stamp_cost", "take_cost", "watch_jit",
 ]
 
 
@@ -71,6 +78,7 @@ class _TracerProvider:
 REGISTRY.register("compile", COMPILE_WATCH)
 REGISTRY.register("health", HEALTH)
 REGISTRY.register("trace", _TracerProvider())
+REGISTRY.register("memory", MEMWATCH)
 
 
 def snapshot() -> dict:

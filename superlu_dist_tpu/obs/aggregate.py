@@ -1,0 +1,206 @@
+"""Cross-replica snapshot aggregation: one fleet view (ISSUE 19).
+
+`merge()` joins per-replica export snapshots (obs/export.py
+export_snapshot records — fetched over the replica wire protocol,
+the SLU_OBS_EXPORT endpoint, or read back from the periodic JSONL)
+into a single fleet view keyed by the boot-unique `replica` id
+(obs/flight.replica_id): fleet-wide SLO burn per key, summed cache
+hit/miss/adopt/lease counters, summed breaker states, per-replica
+mesh legs and staleness stamps.
+
+Containment contract (the controller reads this every tick, so it
+must never crash on a bad input): a torn snapshot (wrong schema,
+missing obs payload, no replica id), a stale one (ts older than
+`stale_s`), a duplicate replica (two generations of one process, or
+one process polled twice) and a plain None (a fetch that failed) are
+all TOLERATED — dropped/stale inputs are counted and stamped, the
+newest (seq, ts) wins a duplicate, and the merge always returns a
+well-formed view.  `tools/fleet_top.py` renders this view; the
+controller's `signals_from_snapshots` (fleet/controller.py) turns it
+into FleetSignals.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+from .export import EXPORT_SCHEMA, EXPORT_VERSION
+
+FLEET_SCHEMA = "slu.obs.fleet"
+FLEET_VERSION = 1
+
+# default staleness horizon: a snapshot older than this is stamped
+# stale (still merged — the stamp is the signal, the data may be the
+# best available view of a wedged replica)
+DEFAULT_STALE_S = 30.0
+
+# cache counters summed fleet-wide (serve/factor_cache.py stats keys)
+_CACHE_SUM_KEYS = (
+    "entries", "plans", "bytes_resident", "hits", "misses",
+    "pattern_hits", "evictions", "single_flight_waits",
+    "factorizations", "store_hits", "store_saves",
+    "store_quarantined", "factor_retries", "breaker_rejected",
+    "fleet_adopted", "fleet_leads",
+)
+
+_HEALTH_SUM_KEYS = ("factorizations", "solves", "tiny_pivots_total",
+                    "escalations", "stalled_refines",
+                    "perturbed_factorizations")
+
+
+def is_export_snapshot(obj) -> bool:
+    """One usable export snapshot: schema-stamped, versioned, with a
+    replica id and an obs payload.  Anything else is torn."""
+    return (isinstance(obj, dict)
+            and obj.get("schema") == EXPORT_SCHEMA
+            and isinstance(obj.get("version"), int)
+            and obj.get("version") <= EXPORT_VERSION
+            and isinstance(obj.get("replica"), str)
+            and isinstance(obj.get("obs"), dict))
+
+
+def _num(d: dict, key: str) -> float | None:
+    v = d.get(key)
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def merge(snapshots, now: float | None = None,
+          stale_s: float = DEFAULT_STALE_S) -> dict:
+    """Merge an iterable of export snapshots (dicts, possibly torn,
+    stale, duplicated or None) into the fleet view."""
+    now = time.time() if now is None else float(now)
+    dropped = 0
+    dropped_reasons: dict[str, int] = {}
+    by_replica: dict[str, dict] = {}
+    for snap in snapshots:
+        if snap is None:
+            dropped += 1
+            dropped_reasons["missing"] = \
+                dropped_reasons.get("missing", 0) + 1
+            continue
+        if not is_export_snapshot(snap):
+            dropped += 1
+            dropped_reasons["torn"] = \
+                dropped_reasons.get("torn", 0) + 1
+            continue
+        rid = snap["replica"]
+        prev = by_replica.get(rid)
+        if prev is not None:
+            # duplicate replica: newest (seq, ts) wins
+            key = (snap.get("seq") or 0, snap.get("ts") or 0.0)
+            pkey = (prev.get("seq") or 0, prev.get("ts") or 0.0)
+            if key <= pkey:
+                dropped_reasons["duplicate"] = \
+                    dropped_reasons.get("duplicate", 0) + 1
+                continue
+            dropped_reasons["duplicate"] = \
+                dropped_reasons.get("duplicate", 0) + 1
+        by_replica[rid] = snap
+
+    replicas: dict[str, dict] = {}
+    burn: dict[str, float] = {}
+    cache: dict[str, float] = {}
+    breaker_by_state: dict[str, int] = {}
+    health: dict[str, float] = {}
+    popularity: dict = {}
+    max_stale = 0.0
+    stale_replicas = []
+    for rid, snap in sorted(by_replica.items()):
+        ts = snap.get("ts")
+        age = max(0.0, now - float(ts)) if isinstance(
+            ts, (int, float)) else math.inf
+        is_stale = age > stale_s
+        if is_stale:
+            stale_replicas.append(rid)
+        max_stale = max(max_stale, age)
+        obs = snap["obs"]
+        row = {
+            "ts": ts, "seq": snap.get("seq"),
+            "pid": snap.get("pid"),
+            "stale_s": age if age != math.inf else None,
+            "stale": is_stale,
+        }
+        # per-replica mesh legs (serve metrics surface them when
+        # mesh-resident serving is on; absent rows stay absent)
+        serve = obs.get("serve")
+        if isinstance(serve, dict):
+            for k in ("mesh", "mesh_shape", "mesh_devices"):
+                if k in serve:
+                    row[k] = serve[k]
+        c = obs.get("cache")
+        if isinstance(c, dict):
+            row["factorizations"] = c.get("factorizations")
+            row["hit_rate"] = c.get("hit_rate")
+            for k in _CACHE_SUM_KEYS:
+                v = _num(c, k)
+                if v is not None:
+                    cache[k] = cache.get(k, 0.0) + v
+            bs = c.get("breaker_by_state")
+            if isinstance(bs, dict):
+                for st, cnt in bs.items():
+                    if isinstance(cnt, (int, float)):
+                        breaker_by_state[st] = \
+                            breaker_by_state.get(st, 0) + int(cnt)
+        h = obs.get("health")
+        if isinstance(h, dict):
+            for k in _HEALTH_SUM_KEYS:
+                v = _num(h, k)
+                if v is not None:
+                    health[k] = health.get(k, 0.0) + v
+        slo = obs.get("slo")
+        if isinstance(slo, dict):
+            for key, rec in (slo.get("keys") or {}).items():
+                if not isinstance(rec, dict):
+                    continue
+                worst = 0.0
+                for dim in ("burn_rate_availability",
+                            "burn_rate_latency"):
+                    v = _num(rec, dim)
+                    if v is not None:
+                        worst = max(worst, v)
+                burn[key] = max(burn.get(key, 0.0), worst)
+                row.setdefault("burn", 0.0)
+                if key != "unrouted":
+                    row["burn"] = max(row["burn"], worst)
+        fleet = obs.get("fleet")
+        if isinstance(fleet, dict):
+            # drill replicas register a "fleet" provider carrying
+            # their demand ledger in fleet-comparable form
+            for ent in fleet.get("popularity") or ():
+                if not isinstance(ent, dict) or "key_i" not in ent:
+                    continue
+                ki = ent["key_i"]
+                agg = popularity.setdefault(
+                    ki, {"key_i": ki, "count": 0, "resident": False})
+                agg["count"] += int(ent.get("count") or 0)
+                agg["resident"] = (agg["resident"]
+                                   or bool(ent.get("resident")))
+        replicas[rid] = row
+
+    hits = cache.get("hits", 0.0)
+    misses = cache.get("misses", 0.0)
+    if hits or misses:
+        cache["hit_rate"] = hits / (hits + misses)
+    burn_max = max((v for k, v in burn.items() if k != "unrouted"),
+                   default=0.0)
+    return {
+        "schema": FLEET_SCHEMA,
+        "version": FLEET_VERSION,
+        "ts": now,
+        "n_replicas": len(replicas),
+        "replicas": replicas,
+        "dropped": dropped,
+        "dropped_reasons": dropped_reasons,
+        "stale_replicas": stale_replicas,
+        "max_stale_s": (max_stale if max_stale != math.inf
+                        else None),
+        "burn": burn,
+        "burn_max": burn_max,
+        "cache": cache,
+        "breaker_by_state": breaker_by_state,
+        "health": health,
+        "popularity": sorted(popularity.values(),
+                             key=lambda e: e["count"], reverse=True),
+    }

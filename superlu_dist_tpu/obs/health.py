@@ -62,12 +62,14 @@ class HealthMonitor:
     def record_factor(self, *, tiny_pivots: int = 0,
                       pivot_growth: float | None = None,
                       dtype: str = "",
-                      perturbation: dict | None = None) -> None:
+                      perturbation: dict | None = None,
+                      mem: dict | None = None) -> None:
         """One factorization's numerical outcome.  `perturbation` is
         the tiny-pivot ledger dict (numerics/ledger.to_dict()) when
         GESP replaced any pivots; it rides the per-factorization ring
         so snapshot() exposes WHERE and how much, not just a lifetime
-        count."""
+        count.  `mem` is the device-memory watermark record
+        (obs/memory.py) — every factorization carries one."""
         with self._lock:
             self.factorizations += 1
             self.tiny_pivots_total += int(tiny_pivots)
@@ -82,6 +84,7 @@ class HealthMonitor:
                                  if pivot_growth is not None else None),
                 "perturbation": (dict(perturbation)
                                  if perturbation is not None else None),
+                "mem": dict(mem) if mem is not None else None,
             })
         if tiny_pivots:
             _tracer.instant("health.tiny_pivots", cat="health",

@@ -13,10 +13,15 @@ additionally reuses device factor buffers (models/gssvx.py).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import threading
+import time
 
 import numpy as np
 import scipy.sparse as sp
 
+from .. import flags
 from ..options import Options
 from ..sparse import CSRMatrix
 from ..utils.stats import Stats
@@ -89,6 +94,40 @@ class FactorPlan:
                 * self.col_scale[self.coo_cols])
 
 
+def pattern_sha1(a: CSRMatrix) -> str:
+    """Sparsity-pattern fingerprint (indptr + indices bytes): the key
+    the PLAN_LATENCY record carries so a plan-build wall is traceable
+    to the exact pattern it planned (ROADMAP 5a)."""
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(a.indptr).tobytes())
+    h.update(np.ascontiguousarray(a.indices).tobytes())
+    return h.hexdigest()
+
+
+# PLAN_LATENCY sink (ROADMAP 5a, ISSUE 19): one JSONL line per cold
+# plan build when SLU_PLAN_LATENCY_OUT is set.  Tracer sink
+# discipline: the first I/O error disables the sink for the process
+# (observability never throws into the planning path).
+_pl_lock = threading.Lock()
+_pl_error: str | None = None
+
+
+def _note_plan_latency(rec: dict) -> None:
+    global _pl_error
+    path = flags.env_opt("SLU_PLAN_LATENCY_OUT")
+    if not path or _pl_error is not None:
+        return
+    try:
+        line = json.dumps(rec)
+        with _pl_lock:
+            if _pl_error is not None:
+                return
+            with open(path, "a") as f:
+                f.write(line + "\n")
+    except (OSError, ValueError, TypeError) as e:
+        _pl_error = repr(e)
+
+
 def _check_structure(a: CSRMatrix, coo_rows, coo_cols) -> None:
     """Raise typed StructurallySingularError for rows/columns with no
     STORED entry.  Pattern-based on purpose: an explicitly stored
@@ -135,6 +174,7 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
     if a.m != a.n:
         raise ValueError("solver requires a square matrix")
     n = a.n
+    t_plan0 = time.perf_counter()
 
     coo_rows, coo_cols, _ = a.to_coo()
 
@@ -174,9 +214,18 @@ def plan_factorization(a: CSRMatrix, options: Options | None = None,
             nd_threads=options.nd_threads)
 
     anorm = float(np.max(np.abs(scaled_vals))) if len(scaled_vals) else 1.0
-    return plan_from_perms(n, options, stats, equed, r_eff, c_eff,
+    plan = plan_from_perms(n, options, stats, equed, r_eff, c_eff,
                            perm_r, perm_c, coo_rows, coo_cols, anorm,
                            autotune=autotune)
+    if flags.env_opt("SLU_PLAN_LATENCY_OUT"):
+        _note_plan_latency({
+            "mode": "plan_latency", "source": "plan",
+            "n": int(n), "nnz": int(len(coo_rows)),
+            "pattern_sha1": pattern_sha1(a),
+            "t_plan_s": round(time.perf_counter() - t_plan0, 6),
+            "ts": time.time(),
+        })
+    return plan
 
 
 def plan_from_perms(n: int, options: Options, stats: Stats,

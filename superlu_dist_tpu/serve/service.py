@@ -50,6 +50,7 @@ import numpy as np
 from .. import flags
 from ..models.gssvx import LUFactorization, solve
 from ..obs import flight, slo
+from ..obs import registry as obs_registry
 from ..options import Options, merge_solve_options, solve_options_key
 from ..resilience import breaker as breaker_defaults
 from ..resilience.breaker import CircuitBreaker
@@ -292,6 +293,23 @@ def _ensure_blas_limit() -> None:
         pass
 
 
+class _CacheObsProvider:
+    """Registry shim over a FactorCache: its stats() counters plus
+    the breaker's by_state, in JSON-safe form — the "cache" leg of
+    the export snapshot (obs/export.py) that obs/aggregate.py sums
+    into the fleet view."""
+
+    def __init__(self, cache: FactorCache) -> None:
+        self._cache = cache
+
+    def snapshot(self) -> dict:
+        out = dict(self._cache.stats())
+        br = self._cache.breaker
+        out["breaker_by_state"] = (br.snapshot()["by_state"]
+                                   if br is not None else {})
+        return out
+
+
 class SolveService:
     def __init__(self, config: ServeConfig | None = None,
                  metrics: Metrics | None = None,
@@ -374,6 +392,11 @@ class SolveService:
         # --flight-ab <=5% overhead budget).
         self._pending_fin: collections.deque = collections.deque()
         flight.register_drain_hook(self._drain_observability)
+        # the cache's counters become the registry's "cache" surface —
+        # what the export plane (obs/export.py) ships off-process and
+        # obs/aggregate.py sums fleet-wide.  Last-wins like "serve".
+        self._cache_obs = _CacheObsProvider(self.cache)
+        obs_registry.REGISTRY.register("cache", self._cache_obs)
 
     # -- operator surface ---------------------------------------------
 
@@ -517,6 +540,7 @@ class SolveService:
             b.close()
         self._drain_observability()
         self.metrics.unregister_obs("serve")
+        obs_registry.REGISTRY.unregister("cache", self._cache_obs)
 
     def drain_observability(self) -> None:
         """Flush deferred flight/SLO finalizations NOW — call before

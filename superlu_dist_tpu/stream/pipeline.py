@@ -307,6 +307,13 @@ class StreamHandle:
             "refactors": counts["refactors"],
             "refactor_failures": counts["refactor_failures"],
             "guard_breaches": counts["guard_breaches"],
+            # the resident generation's device-memory watermark pair
+            # (obs/memory.py): what the live factors cost to hold
+            "mem_watermarks": (dict(g.lu.stats.mem_watermarks)
+                               if g is not None and g.lu.stats
+                               is not None
+                               and g.lu.stats.mem_watermarks
+                               else None),
         }
 
     def close(self) -> None:
@@ -674,7 +681,11 @@ class StreamHandle:
         # factor path computed one) feeds the rcond-drift trigger
         self.cadence.note_rcond(getattr(lu, "rcond", None))
         self.metrics.inc("stream.swaps")
+        mem = (lu.stats.mem_watermarks
+               if lu.stats is not None else None) or {}
         obs.instant("stream.swap", cat="stream",
                     args={"gen": g.gen, "step": step,
                           "trigger": trigger,
-                          "wall_s": round(wall, 3)})
+                          "wall_s": round(wall, 3),
+                          "peak_bytes":
+                          mem.get("peak_bytes_measured")})

@@ -114,6 +114,12 @@ class Stats:
     # (escalation ladder, SamePattern refresh) shows WHICH
     # factorization perturbed, not just a blended total
     factor_events: list = dataclasses.field(default_factory=list)
+    # device-memory watermarks of the LAST factorization under this
+    # Stats (obs/memory.py, ISSUE 19): the plan_bytes_predicted /
+    # peak_bytes_measured pair that makes the spill-tier design
+    # falsifiable; per-factorization copies ride factor_events
+    mem_watermarks: Dict[str, object] = dataclasses.field(
+        default_factory=dict)
     # condition estimate of the LAST factorization served through this
     # run (numerics/gscon.ensure_rcond), None when not estimated
     rcond: float | None = None
@@ -136,11 +142,15 @@ class Stats:
         self.ops[phase] = self.ops.get(phase, 0.0) + flops
 
     def note_factor_event(self, *, tiny_pivots: int = 0,
-                          dtype: str = "") -> None:
+                          dtype: str = "",
+                          mem: dict | None = None) -> None:
         """One factorization's per-run record (called from
-        models/gssvx.factorize)."""
+        models/gssvx.factorize).  `mem` is the obs/memory.py
+        watermark record — every factorization event carries one."""
         self.factor_events.append({"tiny_pivots": int(tiny_pivots),
-                                   "dtype": str(dtype)})
+                                   "dtype": str(dtype),
+                                   "mem": (dict(mem)
+                                           if mem is not None else None)})
 
     def set_measured_cost(self, phase: str, cost: dict | None) -> None:
         """Adopt an XLA cost-analysis record ({flops, bytes}) for ONE
@@ -182,6 +192,7 @@ class Stats:
             "lu_bytes": self.lu_bytes,
             "comm_predicted": dict(self.comm_predicted),
             "factor_events": [dict(e) for e in self.factor_events],
+            "mem_watermarks": dict(self.mem_watermarks),
             "rcond": self.rcond,
         }
 

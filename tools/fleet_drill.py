@@ -152,6 +152,28 @@ def run_replica(name: str, socket_path: str, store_dir: str,
     keys = [matrix_key(m, opts) for m in mats]
     key_index = {kk: i for i, kk in enumerate(keys)}
 
+    # drill-side "fleet" registry provider: the cache's demand ledger
+    # in fleet-comparable form (drill key INDICES, not CacheKeys) plus
+    # the QoS gate — so the replica's export snapshot carries
+    # everything obs/aggregate.py needs to merge popularity and the
+    # remote gather (signals_from_snapshots) needs no "stats" cmd
+    from superlu_dist_tpu.obs import export as obs_export
+    from superlu_dist_tpu.obs.registry import REGISTRY
+
+    class _FleetLedgerProvider:
+        @staticmethod
+        def snapshot() -> dict:
+            return {
+                "popularity": [{"key_i": key_index[e["key"]],
+                                "count": e["count"],
+                                "resident": e["resident"]}
+                               for e in svc.cache.popularity()
+                               if e["key"] in key_index],
+                "qos": qos.snapshot(),
+            }
+
+    REGISTRY.register("fleet", _FleetLedgerProvider())
+
     def handle(conn) -> None:
         rng_cache: dict = {}
         while True:
@@ -250,6 +272,13 @@ def run_replica(name: str, socket_path: str, store_dir: str,
                             if k_ in ("replica", "started",
                                       "finished", "by_outcome")},
                     })
+                elif cmd == "obs_export":
+                    # the export plane over the replica wire protocol
+                    # (ISSUE 19): the same versioned record the
+                    # SLU_OBS_EXPORT endpoint serves — what feeds
+                    # FleetController.gather() remotely
+                    svc.drain_observability()
+                    conn.send(obs_export.export_snapshot())
                 elif cmd == "chaos":
                     chaos.install(msg["spec"],
                                   seed=int(msg.get("seed", 0)))
@@ -828,32 +857,32 @@ def run_day_drill(argv=()) -> dict:
 
     shed_table = {"fractions": {}}
 
+    # the remote gather (ISSUE 19): FleetSignals built SOLELY from
+    # exported snapshots — each replica answers "obs_export" with the
+    # same versioned record its SLU_OBS_EXPORT endpoint would serve,
+    # and signals_from_snapshots merges them through obs/aggregate.
+    # A replica that dies mid-gather yields None: counted in
+    # controller.gather_failures on `ctl_metrics`, stamped inf in
+    # snapshot_stale_s, never a crash.
+    from superlu_dist_tpu.fleet.controller import \
+        signals_from_snapshots
+    from superlu_dist_tpu.serve.metrics import Metrics
+    ctl_metrics = Metrics()
+
     def gather() -> FleetSignals:
-        burn = 0.0
-        pop: dict[int, list] = {}
-        breaker_by_state: dict[str, int] = {}
+        snaps: dict = {}
         for n in sorted(state["live"]):
-            s = client.request([n], {"cmd": "stats"}, 30.0)
-            if s is None:
-                continue
-            ledger.update(n, s["cache"]["factorizations"])
-            burn = max(burn, float(s.get("burn", 0.0)))
-            for e in s.get("popularity", ()):
-                cur = pop.setdefault(int(e["key_i"]), [0, False])
-                cur[0] += int(e["count"])
-                cur[1] = cur[1] or bool(e["resident"])
-            for st_, c in (s.get("breaker") or {}).get(
-                    "by_state", {}).items():
-                breaker_by_state[st_] = \
-                    breaker_by_state.get(st_, 0) + int(c)
-        popularity = tuple(
-            {"key": i, "count": c, "resident": r,
-             "home": state["ring"].home(route_keys[i])}
-            for i, (c, r) in sorted(pop.items()))
-        return FleetSignals(burn=burn,
-                            replicas=tuple(sorted(state["live"])),
-                            popularity=popularity,
-                            breaker_by_state=breaker_by_state)
+            s = client.request([n], {"cmd": "obs_export"}, 30.0)
+            snaps[n] = s
+            if s is not None:
+                c = (s.get("obs") or {}).get("cache") or {}
+                if "factorizations" in c:
+                    ledger.update(n, int(c["factorizations"]))
+        return signals_from_snapshots(
+            snaps,
+            key_home=lambda ki: state["ring"].home(route_keys[ki]),
+            replicas=tuple(sorted(state["live"])),
+            metrics=ctl_metrics)
 
     scaler = ReplicaScaler(
         membership,
@@ -1188,6 +1217,12 @@ def run_day_drill(argv=()) -> dict:
         "ring_changes": state["ring_changes"],
         "fleet_factorizations_per_cold_key": ratio,
         "platform": env.get("JAX_PLATFORMS", "cpu").split(",")[0],
+        # the day's signals came exclusively from exported remote
+        # snapshots (ISSUE 19); fetch failures were contained, not
+        # crashed — the kill phase normally produces a few
+        "remote_gather": True,
+        "gather_failures":
+            ctl_metrics.counter("controller.gather_failures"),
     })
     worst_p99 = max((p["p99_ms"] for p in phases), default=0.0)
     report["worst_phase_p99_ms"] = worst_p99

@@ -1,0 +1,161 @@
+"""fleet_top: one fleet view from per-replica export snapshots.
+
+The control room's `top`: merges per-replica obs export snapshots
+(obs/export.py records) into the fleet view (obs/aggregate.py) and
+renders it — fleet-wide SLO burn per key, summed cache
+hit/miss/adopt rates, breaker states, per-replica rows with mesh
+legs and staleness stamps.
+
+Sources (any mix, any count):
+
+  * a JSONL file of snapshot lines (the SLU_OBS_EXPORT_JSONL
+    write-through, or lines you collected yourself) — every line is
+    merged; the newest (seq, ts) per replica wins;
+  * a live endpoint address ('unix:/path/sock', 'host:port', or a
+    bare port on 127.0.0.1) — fetched once via obs/export.fetch.
+
+Usage:
+    python -m tools.fleet_top [--json] [--stale-s S] SOURCE [...]
+
+`--json` emits the raw fleet view (schema slu.obs.fleet) instead of
+the table.  CLI hygiene matches tools/trace_export.py: a malformed
+JSONL line or an unreachable endpoint is a clean one-line error and
+rc=1 (aggregate-level tolerance is for the CONTROLLER's hot loop;
+an operator pointing the CLI at a corrupt file wants to know);
+usage errors are rc=2.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_USAGE = ("usage: python -m tools.fleet_top [--json] [--stale-s S] "
+          "SOURCE [SOURCE ...]\n"
+          "  SOURCE: export-snapshot JSONL file, or endpoint address "
+          "('unix:/path/sock' | 'host:port' | bare port)")
+
+
+def load_source(src: str) -> list:
+    """Snapshots from one source.  A path that exists (or looks like
+    a file) is read as JSONL; anything else is fetched as a live
+    endpoint.  Raises ValueError/OSError on corrupt or unreachable
+    input — main() turns that into the rc=1 contract."""
+    if os.path.exists(src) or src.endswith((".jsonl", ".json")):
+        out = []
+        with open(src) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError as e:
+                    raise ValueError(
+                        f"{src}:{i}: malformed JSONL line: {e}"
+                    ) from e
+        return out
+    from superlu_dist_tpu.obs import export
+    return [export.fetch(src)]
+
+
+def _fmt_bytes(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0:
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+    return f"{v:.1f}PiB"
+
+
+def render(fleet: dict) -> str:
+    """The human view: fleet totals, then one row per replica."""
+    lines = []
+    cache = fleet.get("cache", {})
+    hr = cache.get("hit_rate")
+    lines.append(
+        f"fleet: {fleet['n_replicas']} replicas"
+        f"  dropped={fleet['dropped']}"
+        f"{' ' + str(fleet['dropped_reasons']) if fleet['dropped'] else ''}"
+        f"  stale={len(fleet.get('stale_replicas', []))}")
+    lines.append(
+        f"cache: factorizations={cache.get('factorizations', 0):.0f}"
+        f"  hits={cache.get('hits', 0):.0f}"
+        f"  misses={cache.get('misses', 0):.0f}"
+        f"  hit_rate={hr:.3f}" if hr is not None else
+        f"cache: factorizations={cache.get('factorizations', 0):.0f}")
+    lines.append(
+        f"fleet coord: adopted={cache.get('fleet_adopted', 0):.0f}"
+        f"  leads={cache.get('fleet_leads', 0):.0f}"
+        f"  store_hits={cache.get('store_hits', 0):.0f}"
+        f"  bytes_resident={_fmt_bytes(cache.get('bytes_resident'))}")
+    if fleet.get("breaker_by_state"):
+        lines.append(f"breakers: {fleet['breaker_by_state']}")
+    lines.append(f"burn: max={fleet.get('burn_max', 0.0):.3f}")
+    for key, v in sorted(fleet.get("burn", {}).items(),
+                         key=lambda kv: -kv[1])[:8]:
+        lines.append(f"  {key}: {v:.3f}")
+    pop = fleet.get("popularity") or []
+    if pop:
+        lines.append("hot keys (count, resident):")
+        for ent in pop[:8]:
+            lines.append(f"  key_i={ent['key_i']}"
+                         f"  count={ent['count']}"
+                         f"  resident={ent['resident']}")
+    lines.append(f"{'replica':<16} {'seq':>5} {'stale_s':>8} "
+                 f"{'factor':>7} {'hit_rate':>8} {'burn':>7}")
+    for rid, row in sorted(fleet.get("replicas", {}).items()):
+        st = row.get("stale_s")
+        hr_ = row.get("hit_rate")
+        lines.append(
+            f"{rid:<16} {row.get('seq') or 0:>5} "
+            f"{(f'{st:.1f}' if st is not None else 'inf'):>8}"
+            f"{'*' if row.get('stale') else ' '}"
+            f"{row.get('factorizations') or 0:>6} "
+            f"{(f'{hr_:.3f}' if hr_ is not None else '-'):>8} "
+            f"{row.get('burn', 0.0):>7.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    if as_json:
+        argv.remove("--json")
+    stale_s = None
+    if "--stale-s" in argv:
+        i = argv.index("--stale-s")
+        try:
+            stale_s = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print(_USAGE, file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if not argv or any(a.startswith("--") for a in argv):
+        print(_USAGE, file=sys.stderr)
+        return 2
+    from superlu_dist_tpu.obs import aggregate
+    snapshots: list = []
+    try:
+        for src in argv:
+            snapshots.extend(load_source(src))
+    except (OSError, ValueError) as e:
+        print(f"fleet_top: {e}", file=sys.stderr)
+        return 1
+    fleet = aggregate.merge(
+        snapshots,
+        stale_s=(aggregate.DEFAULT_STALE_S if stale_s is None
+                 else stale_s))
+    if as_json:
+        print(json.dumps(fleet, default=repr))
+    else:
+        print(render(fleet))
+    return 0
+
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    raise SystemExit(main())

@@ -13,6 +13,11 @@ tolerance:
 
   * serve      — solves/s floor, p95/p99 ceilings, recompiles == 0
   * flight_ab  — flight-recorder overhead within the declared frac
+  * export_ab  — telemetry-export overhead within the same frac
+                 (serve_bench --export-ab, ISSUE 19)
+  * plan.*     — per-(platform, n) cold plan-build + schedule-build
+                 wall ceilings (bench.py --plan-latency,
+                 PLAN_LATENCY.jsonl — ROADMAP 5a)
   * solve      — per-nrhs per-rhs latency ceilings
   * factor     — per-(arm, n) staged factor-wall ceilings + the
                  bitwise merged==legacy pin (bench.py --factor-ab)
@@ -188,6 +193,8 @@ def gather(root: str) -> dict:
             add(rec.get("platform"), "cold_boot", rec)
         elif mode == "stream":
             add(rec.get("platform"), "stream", rec)
+        elif mode == "export_ab":
+            add(rec.get("platform"), "export_ab", rec)
     for rec in _read_jsonl(os.path.join(root, "SOLVE_LATENCY.jsonl")):
         if rec.get("mode") == "factor_ab":
             # staged factor A/B records (bench.py --factor-ab): gate
@@ -223,6 +230,14 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "GRAD.jsonl")):
         if rec.get("mode") == "grad":
             add(rec.get("platform"), "grad", rec)
+    for rec in _read_jsonl(os.path.join(root, "PLAN_LATENCY.jsonl")):
+        # only the bench-committed ladder records gate (they carry
+        # the schedule wall + platform); plan/-emitted source="plan"
+        # lines are raw telemetry, not promoted measurements
+        if (rec.get("mode") == "plan_latency"
+                and rec.get("source") == "bench"
+                and not rec.get("measurement_invalid")):
+            add(rec.get("platform"), f"plan.n{rec.get('n')}", rec)
     for path in sorted(glob.glob(os.path.join(root,
                                               "MULTICHIP_r*.json"))):
         # mesh-resident serving A/B records (bench.py
@@ -353,6 +368,28 @@ def check(history: dict, baselines: dict) -> list[dict]:
                         "ok" if ok else "fail",
                         "" if ok else "flight recorder overhead past "
                         "the declared budget"))
+            elif chk == "export_ab":
+                # same bar as flight_ab: telemetry export must not
+                # cost the serving path more than the declared frac
+                v = _num(latest, "overhead_frac")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "overhead_frac", None, None, None,
+                        "skip", "metric absent"))
+                else:
+                    limit = tol["flight_overhead_frac"]
+                    ok = v <= limit
+                    findings.append(_finding(
+                        p, chk, "overhead_frac", v, 0.0, limit,
+                        "ok" if ok else "fail",
+                        "" if ok else "telemetry export overhead past "
+                        "the declared budget"))
+            elif chk.startswith("plan."):
+                # symbolic-pipeline walls (ROADMAP 5a): plan-build
+                # and schedule-build per n, each ceiling-gated
+                for m in ("t_plan_s", "t_schedule_s"):
+                    ceil_check(p, chk, m, _num(latest, m),
+                               base.get(m), tol["latency_rise_frac"])
             elif chk.startswith("solve."):
                 ceil_check(p, chk, "per_rhs_ms",
                            _num(latest, "per_rhs_ms"),
@@ -645,6 +682,13 @@ def build_baselines(history: dict, tolerances: dict | None = None,
                     for m in ("solves_per_s", "p95_ms", "p99_ms")}
             elif chk == "flight_ab":
                 dst[chk] = {}
+            elif chk == "export_ab":
+                dst[chk] = {}      # the ceiling is a tolerance
+            elif chk.startswith("plan."):
+                dst[chk] = {
+                    m: _median([v for r in win
+                                if (v := _num(r, m)) is not None])
+                    for m in ("t_plan_s", "t_schedule_s")}
             elif chk.startswith("solve."):
                 dst[chk] = {"per_rhs_ms": _median(
                     [v for r in win

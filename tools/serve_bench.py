@@ -6,6 +6,11 @@ and zero silent wrong answers, appending a record to CHAOS.jsonl
 (`--flight-ab`), which measures SLU_FLIGHT=1 against flight-off on
 the same box at the same moment (interleaved trials, median ratio)
 and appends a `flight_ab` record gating the <=5% overhead contract.
+`--export-ab` is the same interleaved discipline for the telemetry
+export plane (ISSUE 19): full SLU_OBS_EXPORT deployment (unix-socket
+listener + a 20 Hz scraper + the JSONL write-through) vs export-off,
+appending an `export_ab` record under the same <=5% budget
+(SLU_EXPORT_MAX_OVERHEAD).
 
 The standard run drives the load with the flight recorder ON (unless
 SLU_FLIGHT=0) and the SLO engine declared (SLU_SLO or a default
@@ -307,6 +312,129 @@ def run_flight_ab(argv=()):
         f.write(line + "\n")
     if overhead > budget:
         print(f"# FLIGHT OVERHEAD REGRESSION: {overhead:.1%} > "
+              f"{budget:.1%} (off {med_off:.1f}, on {med_on:.1f})",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return rec
+
+
+def run_export_ab(argv=()):
+    """Telemetry-export overhead A/B (ISSUE 19): the same load with
+    the export plane OFF vs ON — listener serving a live scraper +
+    the periodic JSONL write-through, i.e. the full SLU_OBS_EXPORT
+    deployment — interleaved exactly like --flight-ab.  Appends an
+    `export_ab` record to SLU_SERVE_OUT and fails (exit 1) when the
+    on-arm loses more than SLU_EXPORT_MAX_OVERHEAD (default 0.05)."""
+    import tempfile
+    import threading
+
+    repo, dev = _jax_env()
+
+    from superlu_dist_tpu import Options
+    from superlu_dist_tpu.obs import export
+    from superlu_dist_tpu.serve import (ServeConfig, SolveService,
+                                        run_load)
+    from superlu_dist_tpu.utils.testmat import laplacian_3d
+
+    k = int(os.environ.get("SLU_SERVE_K", "8"))
+    concurrency = int(os.environ.get("SLU_SERVE_CONCURRENCY", "16"))
+    requests = int(os.environ.get("SLU_SERVE_REQUESTS", "192"))
+    trials = int(os.environ.get("SLU_EXPORT_AB_TRIALS", "5"))
+    budget = float(os.environ.get("SLU_EXPORT_MAX_OVERHEAD", "0.05"))
+    out_path = os.environ.get(
+        "SLU_SERVE_OUT", os.path.join(repo, "SERVE_LATENCY.jsonl"))
+
+    a = laplacian_3d(k)
+    svc = SolveService(ServeConfig(
+        max_queue_depth=max(64, 4 * requests)))
+    print(f"# export A/B: factoring n={a.n} (k={k}) ...",
+          file=sys.stderr)
+    key = svc.prefactor(a, Options(factor_dtype="float64"))
+
+    workdir = tempfile.mkdtemp(prefix="slu_export_ab_")
+    sock_path = os.path.join(workdir, "obs.sock")
+    jsonl_path = os.path.join(workdir, "obs.jsonl")
+
+    rates: dict = {"off": [], "on": []}
+    ratios = []
+    scrapes = [0]
+    for t in range(trials):
+        order = ("off", "on") if t % 2 == 0 else ("on", "off")
+        pair = {}
+        for arm in order:
+            stop_poll = threading.Event()
+            poller = None
+            if arm == "on":
+                # the ON arm is the full deployment: listener +
+                # periodic JSONL, with a live scraper hitting
+                # /snapshot through the load — the worst realistic
+                # cost, not an idle listener
+                export.configure(enabled=True, listen=f"unix:{sock_path}",
+                                 jsonl_path=jsonl_path, period_s=0.2)
+
+                def poll() -> None:
+                    while not stop_poll.wait(0.05):
+                        try:
+                            export.fetch(f"unix:{sock_path}")
+                            scrapes[0] += 1
+                        except (OSError, ValueError):
+                            pass
+                poller = threading.Thread(target=poll, daemon=True)
+                poller.start()
+            else:
+                export.configure(enabled=False)
+            rep = run_load(svc, [key], requests=requests,
+                           concurrency=concurrency,
+                           hot_fraction=1.0, seed=t)
+            stop_poll.set()
+            if poller is not None:
+                poller.join(timeout=2.0)
+            pair[arm] = rep["solves_per_s"]
+            rates[arm].append(rep["solves_per_s"])
+            print(f"# trial {t} {arm}: "
+                  f"{rep['solves_per_s']:.1f} solves/s",
+                  file=sys.stderr)
+        if pair["off"] > 0 and pair["on"] > 0:
+            ratios.append(pair["on"] / pair["off"])
+        else:
+            print(f"# trial {t}: zero-throughput arm, pair discarded",
+                  file=sys.stderr)
+    export.configure(enabled=False)
+    svc.close()
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    med_off = sorted(rates["off"])[trials // 2]
+    med_on = sorted(rates["on"])[trials // 2]
+    if ratios:
+        med_ratio = sorted(ratios)[len(ratios) // 2]
+        overhead = max(0.0, 1.0 - med_ratio)
+    else:
+        overhead = 1.0          # no valid pair: fail loudly below
+    rec = {
+        "mode": "export_ab",
+        "n": a.n, "k": k,
+        "concurrency": concurrency,
+        "requests": requests,
+        "trials": trials,
+        "scrapes": scrapes[0],
+        "solves_per_s_off": rates["off"],
+        "solves_per_s_on": rates["on"],
+        "median_off": med_off,
+        "median_on": med_on,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "overhead_frac": round(overhead, 4),
+        "budget_frac": budget,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    line = json.dumps(rec)
+    print(line)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+    if overhead > budget:
+        print(f"# EXPORT OVERHEAD REGRESSION: {overhead:.1%} > "
               f"{budget:.1%} (off {med_off:.1f}, on {med_on:.1f})",
               file=sys.stderr)
         raise SystemExit(1)
@@ -1202,6 +1330,12 @@ def main():
         repo = os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))
         run_flight_ab(argv)
+        _regress_gate(repo)
+        return
+    if "--export-ab" in argv:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        run_export_ab(argv)
         _regress_gate(repo)
         return
     rec = run(argv)

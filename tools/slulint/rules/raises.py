@@ -36,8 +36,12 @@ _PRECONDITION = {"ValueError", "TypeError", "KeyError", "IndexError",
 
 def _serve_scope(path: str) -> bool:
     parts = path.split("/")
+    # obs/ rides the serving hot path (export listener, memory probe,
+    # registry snapshots) — observability must fail typed or not at
+    # all, never throw a generic builtin into a request (ISSUE 19)
     return ("serve" in parts or "resilience" in parts
-            or "stream" in parts or "numerics" in parts)
+            or "stream" in parts or "numerics" in parts
+            or "obs" in parts)
 
 
 def check(tree, src, path, ann):

@@ -16,7 +16,9 @@
 # Artifacts (repo root): TPU_BENCH_LIVE.json (the on-TPU bench line),
 # TPU_SMOKE.jsonl (hardware smoke incl. the complex-path codec-gating
 # measurement), BENCH_SWEEP.jsonl (secondary configs),
-# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 9), FIRE_*.log.
+# TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 9),
+# PLAN_LATENCY.jsonl + FIRE_OBS_SNAPSHOT.json (step 3e: plan-build
+# walls + the round's merged fleet telemetry view), FIRE_*.log.
 set -u
 repo=$(cd "$(dirname "$0")/.." && pwd)
 if [ "${SLU_FIRE_DRYRUN:-0}" = "1" ]; then
@@ -157,6 +159,30 @@ stamp "gauntlet rc=$?"
 SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --grad \
   >> "$log" 2>&1
 stamp "grad gate rc=$?"
+
+# 3e. Fleet observability round (ISSUE 19): plan-build latency gate +
+#     an archived fleet snapshot.  bench.py --plan-latency times cold
+#     plan + schedule builds over the bench ladder and appends gated
+#     records to PLAN_LATENCY.jsonl (regress holds per-(platform, n)
+#     ceilings on both walls); the fleet snapshot leg exports this
+#     process's obs registry through the real export plane and merges
+#     it into the committed-artifact dir, so every fire round leaves
+#     a versioned view of what the telemetry looked like when its
+#     records landed.  Small systems, no device-scale work — both
+#     legs run in the dryrun too; SLU_REGRESS=0 like 3b-3d.
+SLU_REGRESS=0 timeout 900 python "$repo/bench.py" --plan-latency \
+  >> "$log" 2>&1
+stamp "plan-latency rc=$?"
+timeout 120 python -c "
+import json, sys
+sys.path.insert(0, '$repo')
+from superlu_dist_tpu.obs import aggregate, export
+snap = export.export_snapshot()
+fleet = aggregate.merge([snap])
+with open('$repo/FIRE_OBS_SNAPSHOT.json', 'w') as f:
+    json.dump(fleet, f, indent=1, default=repr)
+" >> "$log" 2>&1
+stamp "obs snapshot archived rc=$? -> FIRE_OBS_SNAPSHOT.json"
 
 # 4e. Mesh-resident serving A/B (ISSUE 17): one-device vs mesh
 #     replica on the same key set through the batcher bucket ladder —

@@ -20,6 +20,13 @@ converts those artifacts:
         failed request's failing stage is visible at a glance.  The
         format is auto-detected per line ("rid" + "events" keys).
 
+    python -m tools.trace_export export.jsonl -o obs.trace.json
+        convert a periodic obs-export log (SLU_OBS_EXPORT_JSONL,
+        obs/export.py) into per-replica COUNTER tracks: one pid per
+        replica, one ph="C" series per numeric provider leaf —
+        the replica's counters over the run.  Auto-detected per line
+        (the "slu.obs.snapshot" schema stamp).
+
 It is also the shared converter tools/tpu_profile.py uses to emit its
 fusion-class buckets as spans in the same trace format
 (`chrome_trace_from_profile`), so the profiled-step breakdown and the
@@ -63,6 +70,56 @@ def is_flight_record(obj) -> bool:
     (obs/flight.py), not a raw trace event."""
     return (isinstance(obj, dict) and "rid" in obj
             and isinstance(obj.get("events"), list))
+
+
+def is_export_snapshot(obj) -> bool:
+    """One SLU_OBS_EXPORT_JSONL line: a periodic obs export snapshot
+    (obs/export.py), not a trace event or flight record.  The schema
+    stamp is matched literally so this tool stays import-free of the
+    package."""
+    return (isinstance(obj, dict)
+            and obj.get("schema") == "slu.obs.snapshot"
+            and isinstance(obj.get("obs"), dict))
+
+
+def snapshots_to_chrome(records: list) -> list:
+    """Export-snapshot lines -> per-replica Chrome COUNTER tracks:
+    one pid per replica (process name "replica <id>"), one ph="C"
+    counter series per numeric leaf of each registered provider
+    (serve.requests, cache.hits, health.factorizations, ...), stamped
+    at the snapshot's wall time.  A periodic SLU_OBS_EXPORT_JSONL
+    thus opens in Perfetto as the replica's counters over the run.
+    Raises ValueError on a malformed record (CLI hygiene: corrupt
+    input is a clean rc=1 error, never a certified-valid trace)."""
+    events: list = []
+    replica_block: dict[str, int] = {}
+    for i, rec in enumerate(records):
+        if not is_export_snapshot(rec):
+            raise ValueError(
+                f"record {i} is not an export snapshot: {rec!r}")
+        replica = str(rec.get("replica") or "?")
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            raise ValueError(f"record {i} ts not numeric: {ts!r}")
+        pid = replica_block.get(replica)
+        if pid is None:
+            pid = replica_block[replica] = len(replica_block)
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": f"replica {replica}"}})
+        ts_us = int(ts * 1e6)
+        for provider, surf in sorted(rec["obs"].items()):
+            if not isinstance(surf, dict):
+                continue
+            for k, v in sorted(surf.items()):
+                if isinstance(v, bool):
+                    v = int(v)
+                if not isinstance(v, (int, float)):
+                    continue        # lists/dicts/strings: not counters
+                events.append({"name": f"{provider}.{k}", "cat": "obs",
+                               "ph": "C", "ts": ts_us, "pid": pid,
+                               "tid": 0, "args": {"value": v}})
+    return events
 
 
 # replicas are spaced at least this far apart in the pid namespace:
@@ -181,6 +238,9 @@ def load(path: str) -> list:
             events = [json.loads(line) for line in f if line.strip()]
             if not events:
                 raise ValueError(f"{path}: empty JSONL event log")
+            if any(is_export_snapshot(e) for e in events):
+                # all-or-nothing, like the flight branch below
+                return snapshots_to_chrome(events)
             if any(is_flight_record(e) for e in events):
                 # all-or-nothing: a mixed log is corrupt, and
                 # flight_to_chrome raises on the stragglers
