@@ -277,10 +277,22 @@ def _scipy_cache_put(desc: str, t_scipy: float, ref_relerr: float):
     # flock around the read-modify-write: the background primer and
     # an in-window bench self-healing a miss may write concurrently,
     # and a lost update here re-measures a 10+-minute baseline inside
-    # the next window
+    # the next window.  The lock target is the cache's DIRECTORY fd —
+    # stable across the os.replace below (locking the json itself
+    # races: replace swaps the inode out from under a waiter), and it
+    # leaves no lock file behind (the old `open(path + ".lock", "w")`
+    # regenerated a stray SCIPY_BASELINE.json.lock on every write and
+    # never unlinked it)
     import fcntl
-    with open(_SCIPY_CACHE_PATH + ".lock", "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
+    lock_fd = os.open(
+        os.path.dirname(os.path.abspath(_SCIPY_CACHE_PATH)) or ".",
+        os.O_RDONLY)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        try:       # heal the stray the old scheme left in checkouts
+            os.unlink(_SCIPY_CACHE_PATH + ".lock")
+        except OSError:
+            pass
         data = _scipy_cache_load()
         data[desc] = dict(t_scipy=t_scipy, ref_relerr=ref_relerr,
                           host=_host_fp(),
@@ -289,6 +301,8 @@ def _scipy_cache_put(desc: str, t_scipy: float, ref_relerr: float):
         with open(tmp, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
         os.replace(tmp, _SCIPY_CACHE_PATH)
+    finally:
+        os.close(lock_fd)      # releases the flock
 
 
 def _measure_scipy(a, b, xtrue):
@@ -1157,6 +1171,176 @@ def _grad():
         f.write(json.dumps(rec) + "\n")
 
 
+def _batch():
+    """`bench.py --batch`: the batched-factorization A/B gate (ISSUE 20).
+
+    For each cell of n in {128 (random unsymmetric, density 0.05),
+    512 (laplacian_3d(8))} x k in SLU_BATCH_K (default 64,256): plan
+    ONE template per pattern, warm the full B-ladder
+    (batch/serving.warmup_batch), then factor+solve k perturbed value
+    sets two ways —
+
+      sequential arm:  per_sample_factorize under the SHARED plan +
+                       gssvx.solve per member (the per-sample
+                       execution the bitwise contract names; NOT an
+                       independent factorize(), which would re-
+                       equilibrate from the member's values);
+      batched arm:     top-rung chunks through batch_factorize +
+                       batch_solve.
+
+    Gates (the --factor-ab discipline — a failed gate stamps the line
+    measurement_invalid, persists NOTHING, exits 1):
+
+      * bitwise — batched solutions array_equal the sequential arm's
+        at fp64, every member, every cell;
+      * zero recompiles — COMPILE_WATCH misses on the batch_factor /
+        batch_solve phases stay flat through every timed dispatch
+        after warmup;
+      * throughput — batch/sequential wall ratio at the k=256 / n=128
+        cell >= SLU_BATCH_MIN_SPEEDUP (default 1.5).
+
+    One mode="batch" line appends to SLU_BATCH_OUT (BATCH.jsonl,
+    regress-gated by tools/regress.py)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        os.environ["XLA_FLAGS"] = ensure_portable_cpu_isa(
+            os.environ.get("XLA_FLAGS", ""))
+    import importlib
+
+    import jax
+
+    from superlu_dist_tpu import obs
+    from superlu_dist_tpu.batch import (batch_factorize, batch_ladder,
+                                        batch_solve, bucket_for_batch,
+                                        pad_values, per_sample_factorize,
+                                        shared_plan, warmup_batch)
+    from superlu_dist_tpu.options import IterRefine, Options
+    from superlu_dist_tpu.sparse import CSRMatrix
+    from superlu_dist_tpu.utils.stats import Stats
+    from superlu_dist_tpu.utils.testmat import (laplacian_3d,
+                                                random_unsymmetric)
+    gssvx = importlib.import_module("superlu_dist_tpu.models.gssvx")
+    dev = jax.devices()[0]
+
+    ks = tuple(int(x) for x in os.environ.get(
+        "SLU_BATCH_K", "64,256").split(",") if x.strip())
+    min_ratio = float(os.environ.get("SLU_BATCH_MIN_SPEEDUP", "1.5"))
+    opts = Options(iter_refine=IterRefine.NOREFINE)
+    ladder = batch_ladder()
+    top = ladder[-1]
+
+    def member_handle(plan, a, vals_j):
+        aj = CSRMatrix(a.m, a.n, a.indptr, a.indices, vals_j)
+        lu = gssvx.LUFactorization(
+            plan=plan, backend="jax",
+            device_lu=per_sample_factorize(plan, vals_j),
+            a=aj, stats=Stats())
+        lu.options = opts
+        return lu
+
+    cells = []
+    bitwise_all = True
+    recompiles = 0
+    for n, mk in ((128, lambda: random_unsymmetric(
+            128, density=0.05, seed=1)),
+                  (512, lambda: laplacian_3d(8))):
+        a = mk()
+        plan = shared_plan(a)
+        rng = np.random.default_rng(n)
+        print(f"# batch: warming ladder {ladder} on n={a.n} ...",
+              file=sys.stderr)
+        warmup_batch(plan, a.data, ladder=ladder)
+        # warm the sequential arm too (its B=1 staged programs and the
+        # packed trisolve are separate compiles)
+        np.asarray(gssvx.solve(member_handle(plan, a, a.data),
+                               np.ones(a.n)))
+        for k in ks:
+            vals = np.stack([
+                a.data * (1.0 + 0.05 * rng.standard_normal(
+                    a.data.shape)) for _ in range(k)])
+            bb = rng.standard_normal((k, a.n))
+
+            m0f = obs.COMPILE_WATCH.misses("batch_factor")
+            m0s = obs.COMPILE_WATCH.misses("batch_solve")
+
+            t0 = time.perf_counter()
+            xs_seq = np.empty((k, a.n))
+            for j in range(k):
+                xs_seq[j] = np.asarray(gssvx.solve(
+                    member_handle(plan, a, vals[j]), bb[j]))
+            seq_wall = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            xs_bat = np.empty((k, a.n))
+            for s in range(0, k, top):
+                chunk = vals[s:s + len(vals[s:s + top])]
+                rung = bucket_for_batch(len(chunk), ladder)
+                blu = batch_factorize(plan, pad_values(chunk, rung))
+                x = np.asarray(batch_solve(
+                    blu, pad_values(bb[s:s + len(chunk)], rung)))
+                xs_bat[s:s + len(chunk)] = x[:len(chunk)]
+            bat_wall = time.perf_counter() - t0
+
+            cell_rec = (obs.COMPILE_WATCH.misses("batch_factor") - m0f
+                        + obs.COMPILE_WATCH.misses("batch_solve")
+                        - m0s)
+            recompiles += cell_rec
+            bitwise = bool(np.array_equal(xs_seq, xs_bat))
+            bitwise_all = bitwise_all and bitwise
+            ratio = (seq_wall / bat_wall) if bat_wall > 0 \
+                else float("inf")
+            cells.append(dict(
+                n=int(a.n), k=int(k), nnz=int(len(a.data)),
+                sequential_ms=round(seq_wall * 1e3, 3),
+                batch_ms=round(bat_wall * 1e3, 3),
+                throughput_ratio=round(ratio, 4),
+                bitwise=bitwise, recompiles=int(cell_rec)))
+            print(f"# batch: n={a.n} k={k} seq={seq_wall * 1e3:.1f}ms "
+                  f"batch={bat_wall * 1e3:.1f}ms ratio={ratio:.2f} "
+                  f"bitwise={bitwise} recompiles={cell_rec}",
+                  file=sys.stderr)
+
+    # the gated cell: n=128 at the largest requested k (256 by
+    # default — the regime where the per-dispatch overhead amortizes)
+    gate_cells = [c for c in cells if c["n"] == 128]
+    gate_cell = max(gate_cells, key=lambda c: c["k"]) if gate_cells \
+        else max(cells, key=lambda c: c["k"])
+    gate_ratio = gate_cell["throughput_ratio"]
+    gate = {
+        "passed": bool(bitwise_all and recompiles == 0
+                       and gate_ratio >= min_ratio),
+        "bitwise": bool(bitwise_all),
+        "recompiles": int(recompiles),
+        "ratio_ok": bool(gate_ratio >= min_ratio),
+    }
+    rec = dict(
+        mode="batch", platform=dev.platform,
+        device_kind=getattr(dev, "device_kind", ""),
+        ladder=list(ladder), ks=list(ks),
+        gate_n=int(gate_cell["n"]), gate_k=int(gate_cell["k"]),
+        throughput_ratio=float(gate_ratio),
+        min_ratio=min_ratio, bitwise=bool(bitwise_all),
+        recompiles=int(recompiles), cells=cells, gate=gate,
+        solve_mode=os.environ.get("SLU_BATCH_SOLVE_MODE", "scan"),
+        ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    ok = gate["passed"]
+    if not ok:
+        rec["measurement_invalid"] = True
+    print(json.dumps(rec))
+    if not ok:
+        print(f"# BATCH GATE FAILURE (bitwise={bitwise_all} "
+              f"recompiles={recompiles} ratio={gate_ratio:.3f} "
+              f"min={min_ratio}); record not persisted",
+              file=sys.stderr)
+        raise SystemExit(1)
+    out_path = os.environ.get(
+        "SLU_BATCH_OUT", os.path.join(repo, "BATCH.jsonl"))
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def _plan_latency():
     """`bench.py --plan-latency`: the ROADMAP 5a record (ISSUE 19).
 
@@ -1540,6 +1724,14 @@ def main():
         # second call, adjoint/forward wall ratio ceiling; appends
         # to GRAD.jsonl, gated by tools/regress.py
         _grad()
+        return
+    if "--batch" in sys.argv[1:]:
+        # batched-factorization A/B (ISSUE 20): one schedule, one
+        # warmup, k value sets through batch_factorize/batch_solve vs
+        # the shared-plan per-sample arm — bitwise pin, zero-recompile
+        # pin, throughput-ratio floor; appends to BATCH.jsonl, gated
+        # by tools/regress.py
+        _batch()
         return
     if "--plan-latency" in sys.argv[1:]:
         # symbolic-pipeline latency ladder (ROADMAP 5a / ISSUE 19):

@@ -197,6 +197,15 @@ FLAGS: dict[str, str] = {
     "SLU_MESH_SHAPE": "mesh grid for SLU_SERVE_MESH=1 ('2x2x2', '8'; default: all local devices on one flat axis) — resolved once per ServeConfig construction, zero per-request overhead",
     "SLU_FLEET_MESH": "fleet drill mesh-replica arm (tools/fleet_drill.py): device count each replica process provisions as a CPU mesh (compat.set_cpu_devices) and serves mesh-resident on; 0 (default) = single-device replicas.  All replicas share one shape so cache keys match pool-wide and store adoption/single-flight hold with a mesh leader",
     "SLU_MULTICHIP_OUT": "bench.py --multichip-serve record path (default MULTICHIP_r06.json): the one-device vs mesh-replica serve A/B record (throughput, p99, recompile pin, bitwise-vs-mesh-oracle, per-boundary collective bytes), regress-gated; a failed gate stamps measurement_invalid and persists nothing",
+    # --- batch engine (batch/, serve/coalescer.py, bench.py --batch) ---
+    "SLU_BATCH_SOLVE_MODE": "batched-trisolve program arm (batch/engine.py): 'scan' (default) loops members inside ONE jit via lax.scan, keeping every lane's ops at exact per-sample shapes — the bitwise pin; 'vmap' is the dense batched arm for accelerators (XLA:CPU's batch-collapsed dot kernels reassociate reductions on trim==1 groups, drifting 1-2 ulp, so 'vmap' trades the bitwise pin for batched-kernel throughput).  One env read per cached program build, zero per-dispatch overhead",
+    "SLU_BATCH_LADDER": "batch-size bucket ladder for the batch engine and factor coalescer, comma ints ascending (default '1,4,8,16,32'); sizes quantize UP a rung (short batches pad by replicating a live member), so after warmup the compiled-program population is bounded by the rung count — the zero-recompile contract.  Read once per warmup/coalescer construction",
+    "SLU_BATCH_COALESCE": "1 = serve-layer factor coalescing (serve/coalescer.py): same-pattern cold factor requests arriving within the coalesce window merge into one batch_factorize dispatch up the B-ladder, results fanned back into ordinary per-key cache residents; off (default) = every cold key factors solo (zero overhead: the serve path checks this once per SolveService construction)",
+    "SLU_BATCH_WINDOW_MS": "factor-coalescer max linger (ms, default 2): how long the first cold request of a pattern waits for same-pattern siblings before the flusher dispatches the batch — the factor-side twin of SLU_SERVE_LINGER_MS; latency cost is bounded by the window, throughput gain by the rung reached",
+    "SLU_BATCH_MEMBER_POLICY": "coalescer member-failure policy: 'refuse' (default) = a singular/ill batch member gets its typed per-index refusal (ZeroDivisionError analog) and ONLY that member fails; 'fallback' = failed members retry solo through the ordinary unbatched factor path (costs one extra factorization for the failed member; siblings are untouched either way)",
+    "SLU_BATCH_K": "bench.py --batch batch counts, comma ints (default '64,256'): how many same-pattern systems each A/B arm factors+solves; the k=256 point is the promote-gate measurement",
+    "SLU_BATCH_OUT": "bench.py --batch record path (default BATCH.jsonl): batched-vs-sequential factor+solve A/B under the promote discipline (throughput ratio, bitwise pin, recompile pin); a failed gate stamps measurement_invalid and persists nothing",
+    "SLU_BATCH_MIN_SPEEDUP": "bench.py --batch gate floor on the batched/sequential throughput ratio at the k=256, n=128 point (default 1.5 — the ISSUE-20 bar: one dispatch amortizing schedule/dispatch overhead across B value sets must beat B sequential dispatches clearly, not marginally)",
 }
 
 # Tokens the registry test's grep will hit that are NOT env flags:

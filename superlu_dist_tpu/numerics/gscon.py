@@ -90,6 +90,108 @@ def inv_norm_est(solve_fn, n: int, dtype, max_iter: int = 5) -> float:
     return max(est, 2.0 * float(np.abs(y).sum()) / (3.0 * n))
 
 
+def inv_norm_est_batch(solve_batch_fn, n: int, B: int, dtype,
+                       max_iter: int = 5) -> np.ndarray:
+    """Hager–Higham over a batch of B systems in synchronized
+    iterations: `solve_batch_fn(V, trans)` takes (B, n) and returns
+    (B, n) — the batch engine's solve, one dispatch serving every
+    member's estimator leg.  Each member replays inv_norm_est's exact
+    decision sequence and freezes at ITS OWN convergence point
+    (frozen lanes keep riding the batched solves with their last x;
+    their results are ignored), so with a bitwise per-sample-equal
+    batched solve every member's estimate equals its sequential
+    estimate bitwise (tests/test_batch.py pins this).  Returns (B,)
+    estimates; inf marks a member whose solves went non-finite
+    (caller maps to rcond 0)."""
+    if n == 0:
+        return np.zeros(B)
+    dt = np.dtype(dtype)
+    x = np.full((B, n), 1.0 / n, dtype=dt)
+    est = np.zeros(B)
+    active = np.ones(B, dtype=bool)
+    isinf = np.zeros(B, dtype=bool)
+    j_prev = np.full(B, -1)
+    for _ in range(max(1, int(max_iter))):
+        if not active.any():
+            break
+        y = np.asarray(solve_batch_fn(x, False))
+        xi = _sign(y)
+        z = np.asarray(solve_batch_fn(xi, True))
+        for i in np.flatnonzero(active):
+            if not np.all(np.isfinite(y[i])):
+                isinf[i] = True
+                active[i] = False
+                continue
+            est_new = float(np.abs(y[i]).sum())
+            if not np.all(np.isfinite(z[i])):
+                isinf[i] = True
+                active[i] = False
+                continue
+            j = int(np.argmax(np.abs(z[i])))
+            if est_new <= est[i] or float(np.abs(z[i][j])) <= abs(
+                    float(np.real(np.vdot(z[i], x[i])))):
+                est[i] = max(est[i], est_new)
+                active[i] = False
+                continue
+            est[i] = est_new
+            if j == j_prev[i]:
+                active[i] = False
+                continue
+            j_prev[i] = j
+            x[i] = 0.0
+            x[i, j] = 1.0
+    # Higham's closing alternating-sign bound, one batched solve for
+    # every lane (sequential runs it unconditionally after the loop)
+    v = np.array([(-1.0) ** i * (1.0 + i / max(n - 1, 1))
+                  for i in range(n)], dtype=dt)
+    y = np.asarray(solve_batch_fn(
+        np.broadcast_to(v, (B, n)).copy(), False))
+    out = np.empty(B)
+    for i in range(B):
+        if isinf[i] or not np.all(np.isfinite(y[i])):
+            out[i] = float("inf")
+            continue
+        out[i] = max(est[i],
+                     2.0 * float(np.abs(y[i]).sum()) / (3.0 * n))
+    return out
+
+
+def estimate_rcond_batch(blu, anorms, max_iter: int | None = None
+                         ) -> np.ndarray:
+    """Per-member rcond for a BatchedLU — the estimator legs ride the
+    batched packed trisolve (2·max_iter + 2 batched dispatches serve
+    ALL members' estimates), each member's rcond equal to what
+    estimate_rcond computes on its per-sample handle.  `anorms` is
+    (B,) one-norms of the members (one_norm per member matrix).
+    Masked members (nzero > 0) report 0.0 without poisoning their
+    siblings' estimates — their lanes solve garbage that no other
+    lane reads."""
+    from .. import flags
+    from ..batch.engine import batch_solve
+    if max_iter is None:
+        max_iter = flags.env_int("SLU_COND_MAXITER", 5)
+    B = blu.b
+    anorms = np.asarray(anorms, dtype=np.float64).reshape(B)
+
+    def solve_fn(V, trans):
+        return np.asarray(batch_solve(blu, V, trans=trans))
+
+    with obs.span("gscon_batch", cat="numerics",
+                  args={"n": blu.plan.n, "B": B}):
+        dt = np.promote_types(np.dtype(blu.dtype), np.float64)
+        ainv = inv_norm_est_batch(solve_fn, blu.plan.n, B, dt,
+                                  max_iter=max_iter)
+    ok = blu.ok_mask()
+    out = np.zeros(B)
+    for i in range(B):
+        if not ok[i] or not anorms[i] or not np.isfinite(ainv[i]) \
+                or ainv[i] <= 0.0:
+            out[i] = 0.0
+        else:
+            out[i] = float(min(1.0 / (anorms[i] * ainv[i]), 1.0))
+    return out
+
+
 def estimate_rcond(lu, anorm: float | None = None,
                    max_iter: int | None = None) -> float:
     """rcond = 1/(‖A‖₁·‖A⁻¹‖₁) for a live factorization handle —

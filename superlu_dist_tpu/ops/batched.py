@@ -1296,7 +1296,8 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
                        gather: bool = True, coop: bool = False,
                        ndev: int = 1, pos_idx=None, cp: int = 0,
                        tp: int = 0, pair: bool = False,
-                       pallas_diag: bool = False):
+                       pallas_diag: bool = False,
+                       force_xla: bool = False):
     if pair:
         return _factor_group_impl_pair(
             vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat, tiny,
@@ -1318,8 +1319,12 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
     F = F.at[a_dst].add(vals[a_src], mode="drop",
                         unique_indices=True, indices_are_sorted=True)
     F = F.at[one_dst].set(one, mode="drop", unique_indices=True)
+    # force_xla: the batch engine (superlu_dist_tpu/batch/engine.py)
+    # traces this body under jax.vmap, where a pallas_call's batching
+    # rule is not a path we certify — the _factor_group_impl_pair
+    # precedent, applied to the element scatter AND the panel-LU
     F = _ea_add(F, upd_buf, elem_blocks, ea_meta, mb=mb, n_pad=n_pad,
-                ncols=ncols)
+                ncols=ncols, allow_pallas=not force_xla)
     F = _ea_add_blocks(F, upd_buf, blk_blocks, eb_meta, mb=mb,
                        n_pad=n_pad, ncols=ncols)
     F = F.reshape(n_pad, mb, ncols)
@@ -1353,7 +1358,9 @@ def _factor_group_impl(vals, upd_buf, L_flat, U_flat, Li_flat, Ui_flat,
         # the caller resolved eligibility per member bucket, so this
         # call routes through the kernel unconditionally-if-available
         F, tiny_g, nzero_g = partial_lu_batch(
-            F, thresh, wb=wb, pallas=True if pallas_diag else None)
+            F, thresh, wb=wb,
+            pallas=(False if force_xla
+                    else True if pallas_diag else None))
         Lsrc, Usrc, upd_src = F[:, :, :wb], F[:, :wb, :], F[:, wb:, wb:]
 
     rows = jnp.arange(mb)[:, None]

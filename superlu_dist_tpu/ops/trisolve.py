@@ -614,12 +614,16 @@ def _bwd_member(XF, Y, g, gs, pack, idx, cplx, trans):
 
 
 def sweep(ts: TrisolveSchedule, packs, b, dtype, trans: bool,
-          pair: bool = False, per_group_idx=None):
+          pair: bool = False, per_group_idx=None,
+          force_xla: bool = False):
     """The full merged triangular solve inside one trace: b (n, nrhs)
     in factor ordering -> x (n, nrhs).  Complex systems ride the same
     real-view codec as the legacy sweep (`_enc`/`_dec`); pair mode
     takes pre-encoded b and returns encoded, exactly like
-    `_solve_loop`."""
+    `_solve_loop`.  `force_xla` pins every member to the XLA lsum
+    body — the batch engine (superlu_dist_tpu/batch/engine.py) traces
+    this under jax.vmap, where a pallas_call's batching rule is not a
+    path we certify (the _factor_group_impl_pair precedent)."""
     from . import pallas_lsum
     from .batched import _dec, _enc
     sched = ts.sched
@@ -637,8 +641,8 @@ def sweep(ts: TrisolveSchedule, packs, b, dtype, trans: bool,
     if per_group_idx is None:
         per_group_idx = [gs.dev(squeeze=True) for gs in ts.groups]
 
-    use_pallas = (not pair and not cplx and not trans
-                  and pallas_lsum.enabled(rdt))
+    use_pallas = (not force_xla and not pair and not cplx
+                  and not trans and pallas_lsum.enabled(rdt))
 
     state = (B, UPD, Y)
     for g, gs, pack, idx in zip(sched.groups, ts.groups, packs,

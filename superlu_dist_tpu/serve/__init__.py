@@ -25,6 +25,7 @@ Quickstart:
 """
 
 from .batcher import BUCKET_LADDER, MicroBatcher, bucket_for
+from .coalescer import FactorCoalescer, coalesce_enabled
 from .errors import (DeadlineExceeded, DegradedResult, FactorMissError,
                      FactorPoisoned, FlusherDead, ServeError,
                      ServeRejected, StaleFactorError, factor_cost_hint)
@@ -41,6 +42,7 @@ __all__ = [
     "DeadlineExceeded",
     "DegradedResult",
     "FactorCache",
+    "FactorCoalescer",
     "FactorMissError",
     "FactorPoisoned",
     "FlusherDead",
@@ -53,6 +55,7 @@ __all__ = [
     "SolveService",
     "StaleFactorError",
     "bucket_for",
+    "coalesce_enabled",
     "factor_cost_hint",
     "matrix_key",
     "pattern_fingerprint",

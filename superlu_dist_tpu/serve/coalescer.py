@@ -1,0 +1,249 @@
+"""Batched factor coalescing: the micro-batcher's window discipline
+applied to COLD FACTOR requests (serve/batcher.py does it for the RHS
+axis of warm solves).
+
+Same-pattern cold keys arriving within the coalesce window
+(SLU_BATCH_WINDOW_MS, default 2ms) merge into ONE
+batch.engine.batch_factorize dispatch quantized up the B-ladder
+(batch/serving.py), and the batch fans back into ordinary per-key
+cache residents via member_factorization + FactorCache.put — the
+store, fleet, flight and tier layers never learn the factors were
+born batched.  A group reaching the top ladder rung flushes
+immediately; otherwise a short-lived flusher thread fires at the
+window edge.  Flusher faults are CONTAINED: every pending future
+fails with the flusher's error (FlusherDead wrapping, the batcher's
+discipline) and the next submit starts a fresh group.
+
+Member failure policy (SLU_BATCH_MEMBER_POLICY): 'refuse' (default)
+fails ONLY the singular/non-finite member with its typed per-index
+error — siblings fan back normally (the masked-member contract);
+'fallback' retries failed members solo through the ordinary
+cache.get_or_factorize path.  Either way one bad matrix never poisons
+the batch.
+
+Batching eligibility is conservative: real non-pair factor dtypes
+with identical Options.  A one-member flush, or any engine-level
+refusal (complex dtype, pattern mismatch), falls back member-by-
+member to cache.get_or_factorize — the coalescer can DEGRADE to the
+sequential path, never the reverse.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import flags
+from ..batch.engine import batch_factorize, member_factorization
+from ..batch.plan_share import shared_plan
+from ..batch.serving import batch_ladder, bucket_for_batch, pad_values
+from ..options import Options
+from .errors import DeadlineExceeded, FlusherDead, ServeError
+from .factor_cache import matrix_key
+
+
+def coalesce_enabled() -> bool:
+    """SLU_BATCH_COALESCE=1 turns the serve-layer factor coalescer on
+    (read once per SolveService construction)."""
+    return flags.env_str("SLU_BATCH_COALESCE", "0").strip() == "1"
+
+
+def _window_s() -> float:
+    try:
+        ms = flags.env_float("SLU_BATCH_WINDOW_MS", 2.0)
+    except ValueError:
+        ms = 2.0
+    return max(0.0, ms) / 1000.0
+
+
+def _member_policy() -> str:
+    p = flags.env_str("SLU_BATCH_MEMBER_POLICY", "refuse").strip().lower()
+    return p if p in ("refuse", "fallback") else "refuse"
+
+
+class _Group:
+    """One open coalesce window: same pattern, same options."""
+
+    def __init__(self, options: Options) -> None:
+        self.options = options
+        self.members: list = []     # (key, a, Future)
+        self.closed = False
+
+
+class FactorCoalescer:
+    """Window-coalesced cold-factor dispatch over one FactorCache."""
+
+    def __init__(self, cache, metrics=None,
+                 window_s: float | None = None,
+                 ladder: tuple | None = None,
+                 member_policy: str | None = None) -> None:
+        self.cache = cache
+        self.metrics = metrics if metrics is not None else cache.metrics
+        self.window_s = _window_s() if window_s is None else window_s
+        self.ladder = tuple(ladder) if ladder else batch_ladder()
+        self.member_policy = member_policy or _member_policy()
+        self._lock = threading.Lock()
+        self._groups: dict = {}     # (pattern_sha1, options) -> _Group
+        self._closed = False
+
+    # -- request side -------------------------------------------------
+
+    def submit(self, a, options: Options | None = None, key=None,
+               deadline: float | None = None):
+        """Resident factors for (a, options): cache hit returns
+        immediately; a cold key joins (or opens) its pattern's
+        coalesce window and blocks until the flush fans its member
+        back.  `deadline` (absolute time.monotonic()) bounds the wait
+        — the window is bounded, so this only fires when the batch
+        factorization itself overruns."""
+        options = options or Options()
+        key = key or matrix_key(a, options)
+        lu = self.cache.get(key)
+        if lu is not None:
+            return lu
+        with self._lock:
+            if self._closed:
+                raise ServeError("coalescer is closed")
+            # (pattern fingerprint, options tuple) — the cache's own
+            # plan-reuse key: hashable, and exactly the same-pattern +
+            # same-options membership the batching contract requires
+            gkey = key.pattern_key
+            g = self._groups.get(gkey)
+            fresh = g is None or g.closed
+            if fresh:
+                g = self._groups[gkey] = _Group(options)
+            fut: Future = Future()
+            g.members.append((key, a, fut))
+            full = len(g.members) >= self.ladder[-1]
+            if full:
+                g.closed = True
+                self._groups.pop(gkey, None)
+        if full:
+            self._flush(g)
+        elif fresh:
+            t = threading.Thread(target=self._flusher, args=(gkey, g),
+                                 name="factor-coalescer", daemon=True)
+            t.start()
+        self.metrics.inc("serve.batch_coalesce_submits")
+        timeout = (None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            raise DeadlineExceeded(
+                "deadline passed waiting on the coalesced batch "
+                "factorization") from None
+
+    def close(self) -> None:
+        """Stop admitting; flush whatever is pending NOW (pending
+        members are real requests — they get factors, not errors)."""
+        with self._lock:
+            self._closed = True
+            groups = [g for g in self._groups.values() if not g.closed]
+            for g in groups:
+                g.closed = True
+            self._groups.clear()
+        for g in groups:
+            self._flush(g)
+
+    # -- flusher side -------------------------------------------------
+
+    def _flusher(self, gkey, g: _Group) -> None:
+        time.sleep(self.window_s)
+        with self._lock:
+            if g.closed:        # filled to the top rung, already flushed
+                return
+            g.closed = True
+            self._groups.pop(gkey, None)
+        self._flush(g)
+
+    def _flush(self, g: _Group) -> None:
+        # CONTAINMENT: whatever the flush raises fails every still-
+        # pending member with the SAME error (FlusherDead wrapping, the
+        # batcher's contract) — no future is left hanging, and the next
+        # submit opens a fresh group.
+        try:
+            self._flush_inner(g)
+        except BaseException as e:
+            err = e if isinstance(e, ServeError) else FlusherDead(
+                f"factor coalescer flush died: {e!r}")
+            for _, _, fut in g.members:
+                if not fut.done():
+                    fut.set_exception(err)
+            self.metrics.inc("serve.batch_flush_errors")
+            if not isinstance(e, Exception):
+                raise        # KeyboardInterrupt and friends propagate
+
+    def _flush_inner(self, g: _Group) -> None:
+        if g.members:
+            self._dispatch(g.members, g.options)
+
+    def _dispatch(self, members, options) -> None:
+        options = options or Options()
+        fdt = np.dtype(options.factor_dtype)
+        if len(members) == 1 or fdt.kind == "c":
+            # nothing to batch (or an engine-unsupported dtype):
+            # sequential path, full cache semantics
+            self._solo(members, options)
+            return
+        # plan template = the first member that PLANS (planning reads
+        # the values for equilibration, so a zero-row/degenerate
+        # member must not veto its siblings' batch — it fails alone,
+        # at its own factor step or its own solo plan)
+        plan = None
+        for _, am, _ in members:
+            try:
+                plan = shared_plan(am, options)
+                break
+            except Exception:
+                continue
+        if plan is None:
+            self._solo(members, options)
+            return
+        try:
+            values = np.stack([m[1].data for m in members])
+            rung = bucket_for_batch(len(members), self.ladder)
+            blu = batch_factorize(plan, pad_values(values, rung),
+                                  dtype=fdt)
+        except Exception:
+            # engine refusal (pattern drift inside the group, dtype
+            # gaps): degrade to the sequential path rather than fail
+            # the requests
+            self.metrics.inc("serve.batch_degraded_solo")
+            self._solo(members, options)
+            return
+        self.metrics.inc("serve.batch_flushes")
+        for i, (key, a, fut) in enumerate(members):
+            if fut.done():
+                continue
+            try:
+                lu = member_factorization(blu, i, a=a, options=options)
+                if self.cache.validate_factors:
+                    from .factor_cache import factors_finite
+                    if not factors_finite(lu):
+                        raise ZeroDivisionError(
+                            f"batch member {i}: non-finite factors "
+                            "at this dtype; not cached, not served")
+                self.cache.put(key, lu)
+                fut.set_result(lu)
+                self.metrics.inc("serve.batch_fanned_back")
+            except Exception as e:
+                if self.member_policy == "fallback":
+                    self.metrics.inc("serve.batch_member_fallback")
+                    self._solo([(key, a, fut)], options)
+                else:
+                    self.metrics.inc("serve.batch_member_refused")
+                    fut.set_exception(e)
+
+    def _solo(self, members, options) -> None:
+        for key, a, fut in members:
+            if fut.done():
+                continue
+            try:
+                fut.set_result(self.cache.get_or_factorize(
+                    a, options, key=key))
+            except Exception as e:
+                fut.set_exception(e)

@@ -37,6 +37,9 @@ def run_load(service: SolveService, matrices, *,
              options=None,
              seed: int = 0,
              grad_fraction: float = 0.0,
+             batch_fraction: float = 0.0,
+             batch_singular_fraction: float = 0.0,
+             batch_options=None,
              join_timeout_s: float | None = None) -> dict:
     """Drive `requests` total solves through `service` from
     `concurrency` closed-loop workers; returns the report dict.
@@ -50,6 +53,22 @@ def run_load(service: SolveService, matrices, *,
     the same report prefixed `grad_` (its finite probe covers the
     solution AND both cotangents), so a gate can pin e.g. zero
     `grad_miss_failfast` alongside the solve mix.
+
+    `batch_fraction` of requests are COLD same-pattern factor
+    requests instead: the worker perturbs the picked matrix's values
+    (fresh key, same pattern) and prefactors it — under concurrency
+    these bursts are exactly the traffic the factor coalescer
+    (serve/coalescer.py, SLU_BATCH_COALESCE=1) merges into batched
+    dispatches.  Statuses land prefixed `batch_`: `batch_ok` for a
+    fanned-back resident, `batch_member_refused` for a member's OWN
+    typed refusal (the masked-member contract — a singular member
+    fails per-index, siblings still read batch_ok).
+    `batch_singular_fraction` of those requests carry all-zero values
+    to force that refusal (pair it with
+    `batch_options=Options(replace_tiny_pivot=NO)`; under default
+    options the zero member is perturbed and stamps its ledger
+    instead).  Matrices given as CacheKeys can't seed the lane (no
+    pattern to perturb) and fall through to ordinary solves.
 
     `join_timeout_s` bounds the wait for workers: the report's
     `unresolved` field counts requests that never produced a status —
@@ -94,7 +113,21 @@ def run_load(service: SolveService, matrices, *,
             # generator — a second inline except-chain here had
             # already drifted from it (StaleFactorError folded into
             # serve_error)
-            if grad_fraction > 0.0 and rng.random() < grad_fraction:
+            mat = matrices[mi]
+            if (batch_fraction > 0.0 and hasattr(mat, "data")
+                    and rng.random() < batch_fraction):
+                if (batch_singular_fraction > 0.0
+                        and rng.random() < batch_singular_fraction):
+                    data = np.zeros_like(mat.data)
+                else:
+                    data = mat.data * (1.0 + 0.05 * rng.standard_normal(
+                        len(mat.data)))
+                fresh = type(mat)(mat.m, mat.n, mat.indptr,
+                                  mat.indices, data)
+                status, _x = _status_of_batch(
+                    lambda: service.prefactor(
+                        fresh, batch_options or options))
+            elif grad_fraction > 0.0 and rng.random() < grad_fraction:
                 status, _x = _status_of_grad(
                     lambda: service.grad_solve(matrices[mi], b,
                                                options=options))
@@ -209,6 +242,36 @@ def _status_of_grad(do_grad) -> tuple[str, object]:
         if not np.all(np.isfinite(np.asarray(leg))):
             return "grad_nonfinite", None
     return "grad_ok", res
+
+
+def _status_of_batch(do_factor) -> tuple[str, object]:
+    """One cold same-pattern factor request (the coalescer lane)
+    through a `batch_`-prefixed status taxonomy.  The key property is
+    PER-INDEX typing: `batch_member_refused` is the member's OWN
+    refusal — singular values at factor time (ZeroDivisionError from
+    the batch fan-out or the solo path), a plan-time values refusal
+    (ValueError: empty/zero row), or a numerics-layer refusal — and
+    never bleeds onto siblings, which keep reading `batch_ok`."""
+    from ..numerics.errors import NumericalError
+    try:
+        key = do_factor()
+    except (ZeroDivisionError, NumericalError, ValueError):
+        return "batch_member_refused", None
+    except TenantThrottled:
+        return "batch_shed", None
+    except ServeRejected:
+        return "batch_rejected", None
+    except DeadlineExceeded:
+        return "batch_deadline", None
+    except FactorPoisoned:
+        return "batch_poisoned", None
+    except FlusherDead:
+        return "batch_flusher_dead", None
+    except ServeError:
+        return "batch_serve_error", None
+    except Exception:
+        return "batch_error", None
+    return "batch_ok", key
 
 
 def run_stream_load(streams, *, steps: int = 16,

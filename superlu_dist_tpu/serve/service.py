@@ -397,6 +397,26 @@ class SolveService:
         # obs/aggregate.py sums fleet-wide.  Last-wins like "serve".
         self._cache_obs = _CacheObsProvider(self.cache)
         obs_registry.REGISTRY.register("cache", self._cache_obs)
+        # serve-layer factor coalescer (serve/coalescer.py): cold
+        # same-pattern keys merge into one batch-engine factorization
+        # when SLU_BATCH_COALESCE=1 — one env read at construction,
+        # zero per-request overhead when off
+        from .coalescer import FactorCoalescer, coalesce_enabled
+        self._coalescer = (FactorCoalescer(self.cache,
+                                           metrics=self.metrics)
+                           if coalesce_enabled() else None)
+
+    def _resident_for(self, a, options, key, deadline=None):
+        """The cold-factor acquisition choke point: the factor
+        coalescer (same-pattern keys batch through batch/engine.py,
+        SLU_BATCH_COALESCE=1) or the cache's single-flight
+        get_or_factorize.  Either way the caller gets an ordinary
+        resident LUFactorization."""
+        if self._coalescer is not None:
+            return self._coalescer.submit(a, options, key=key,
+                                          deadline=deadline)
+        return self.cache.get_or_factorize(a, options, key=key,
+                                           deadline=deadline)
 
     # -- operator surface ---------------------------------------------
 
@@ -426,7 +446,7 @@ class SolveService:
                 raise ServeError("service is closed")
         options = self._stamp_mesh(options or Options())
         key = matrix_key(a, options)
-        lu = self.cache.get_or_factorize(a, options, key=key)
+        lu = self._resident_for(a, options, key)
         with self._lock:
             self._prefactor_opts[key] = options
         self._batcher_for(key, lu, options).warmup()
@@ -467,7 +487,7 @@ class SolveService:
                 options = self._stamp_mesh(options or Options())
                 key = matrix_key(a, options)
                 self.cache.note_demand(key)
-                lu = self.cache.get_or_factorize(a, options, key=key)
+                lu = self._resident_for(a, options, key)
                 if A_values is None:
                     A_values = a.data
             self._note_route(rec, lu, served="grad")
@@ -534,6 +554,8 @@ class SolveService:
             self._batchers.clear()
             streams = list(self._streams)
             self._streams.clear()
+        if self._coalescer is not None:
+            self._coalescer.close()
         for s in streams:
             s.close()
         for b in batchers:
@@ -880,8 +902,8 @@ class SolveService:
             # Followers respect the request deadline while waiting;
             # the leader runs to completion (see get_or_factorize)
             try:
-                lu = self.cache.get_or_factorize(a, options, key=key,
-                                                 deadline=deadline)
+                lu = self._resident_for(a, options, key,
+                                        deadline=deadline)
             except (DeadlineExceeded, ServeRejected):
                 raise           # economics, not faults — never degrade
             except Exception as factor_err:

@@ -180,7 +180,8 @@ g = make_solver_mesh(2, 2, 2)
 """
 
 
-def test_coop_complex():
+@pytest.mark.slow          # ~60 s: fresh-subprocess JAX init+compile;
+def test_coop_complex():   # tier-1 keeps the dist complex lanes
     """Coop complex factor+solve over a 3D mesh matches the
     single-device path.  Complex + multi-device client => compile-
     lottery containment (lottery_util docstring)."""
@@ -250,7 +251,8 @@ for _ in range(10):
 """
 
 
-def test_complex_dist_solve_deterministic():
+@pytest.mark.slow          # ~65 s subprocess; the plain complex-coop
+def test_complex_dist_solve_deterministic():   # pin stays adjacent
     """Determinism + dist/single agreement of the complex dist solve.
 
     Regression coverage for two environmental bug families of the

@@ -18,6 +18,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _SCRIPT = r"""
 import json
@@ -74,7 +75,8 @@ print("RESULT " + json.dumps(out))
 """
 
 
-def test_16dev_invariance_and_coop_share():
+@pytest.mark.slow    # ~52 s 16-device subprocess; the 8-dev coop
+def test_16dev_invariance_and_coop_share():   # pins stay in tier-1
     from superlu_dist_tpu.utils.cache import ensure_portable_cpu_isa
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

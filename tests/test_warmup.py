@@ -9,6 +9,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 import scipy.sparse as sp
 
 from superlu_dist_tpu import Options
@@ -134,7 +135,8 @@ def _run_sub(script, cache_dir):
     return json.loads(line[len("RESULT "):])
 
 
-def test_staged_dispatch_hits_warmed_cache(tmp_path):
+@pytest.mark.slow     # ~57 s: two fresh subprocesses (write + read
+def test_staged_dispatch_hits_warmed_cache(tmp_path):   # the cache)
     """A staged dispatch in a FRESH process must land on the programs a
     previous process's warmup_staged wrote to the persistent cache: the
     factor + fwd/bwd sweep compiles must all be persistent-cache HITS

@@ -55,6 +55,11 @@ tolerance:
                  (the adjoint rides the resident factors), the
                  adjoint/forward wall ratio within its ceiling,
                  gate.passed (FD oracle + zero-recompile)
+  * batch      — batched-factorization A/B (bench.py --batch,
+                 BATCH.jsonl): batch/sequential throughput ratio at
+                 the gated cell >= the declared floor, bitwise ==
+                 True (batched == shared-plan per-sample execution),
+                 recompiles == 0 across the B-ladder, gate.passed
   * bench      — GFLOP/s floor
 
 Usage:
@@ -107,6 +112,11 @@ DEFAULT_TOLERANCES = {
     # grad gate: adjoint leg wall over forward leg wall on the SAME
     # resident handle (the ISSUE-18 adjoint-cost acceptance)
     "grad_adjoint_ratio": 1.5,
+    # batch gate: batched-arm over sequential-arm throughput at the
+    # gated k=256/n=128 cell (the ISSUE-20 batching acceptance — an
+    # ABSOLUTE floor, not baseline-relative: below it the batch
+    # engine stopped paying for itself)
+    "batch_min_ratio": 1.5,
 }
 
 
@@ -230,6 +240,9 @@ def gather(root: str) -> dict:
     for rec in _read_jsonl(os.path.join(root, "GRAD.jsonl")):
         if rec.get("mode") == "grad":
             add(rec.get("platform"), "grad", rec)
+    for rec in _read_jsonl(os.path.join(root, "BATCH.jsonl")):
+        if rec.get("mode") == "batch":
+            add(rec.get("platform"), "batch", rec)
     for rec in _read_jsonl(os.path.join(root, "PLAN_LATENCY.jsonl")):
         # only the bench-committed ladder records gate (they carry
         # the schedule wall + platform); plan/-emitted source="plan"
@@ -645,6 +658,39 @@ def check(history: dict, baselines: dict) -> list[dict]:
                     "ok" if ok else "fail",
                     "" if ok else "the grad gate itself failed (FD "
                     "oracle, recompile, or ratio)"))
+            elif chk == "batch":
+                v = _num(latest, "throughput_ratio")
+                if v is None:
+                    findings.append(_finding(
+                        p, chk, "throughput_ratio", None, None, None,
+                        "skip", "metric absent"))
+                else:
+                    limit = tol["batch_min_ratio"]
+                    ok = v >= limit
+                    findings.append(_finding(
+                        p, chk, "throughput_ratio", v, limit, limit,
+                        "ok" if ok else "fail",
+                        "" if ok else "the batched arm stopped "
+                        "beating the sequential arm by the declared "
+                        "floor at the gated cell"))
+                v = latest.get("bitwise")
+                if v is not None:
+                    findings.append(_finding(
+                        p, chk, "bitwise", bool(v), True, True,
+                        "ok" if v else "fail",
+                        "" if v else "batched factor+solve diverged "
+                        "from the shared-plan per-sample execution "
+                        "bitwise"))
+                zero_check(p, chk, "recompiles",
+                           _num(latest, "recompiles"),
+                           "a batch program recompiled after the "
+                           "B-ladder warmup")
+                gate = latest.get("gate", {})
+                ok = bool(gate.get("passed", True))
+                findings.append(_finding(
+                    p, chk, "gate.passed", ok, True, True,
+                    "ok" if ok else "fail",
+                    "" if ok else "the batch A/B gate itself failed"))
             elif chk == "bench":
                 floor_check(p, chk, "gflops",
                             _num(latest, "gflops"),
@@ -721,6 +767,9 @@ def build_baselines(history: dict, tolerances: dict | None = None,
             elif chk == "grad":
                 dst[chk] = {}          # structural gates only: the
                                        # ratio ceiling is a tolerance
+            elif chk == "batch":
+                dst[chk] = {}          # structural gates only: the
+                                       # ratio floor is a tolerance
             elif chk == "multichip":
                 dst[chk] = {
                     m: _median([v for r in win

@@ -167,6 +167,47 @@ def run(argv=()):
             "rungs": ["float64", "float32+df64"],
         }
 
+    # --- batch-coalescer scenario (SLU_BATCH_COALESCE=1): the solve
+    # mix gains a batch_fraction lane of COLD same-pattern factor
+    # requests (perturbed values -> fresh keys), which the factor
+    # coalescer (serve/coalescer.py) merges into batched dispatches
+    # up the B-ladder.  A slice of those requests carries all-zero
+    # values under a replace_tiny_pivot=NO option set, pinning the
+    # masked-member contract under concurrent load: those requests
+    # read batch_member_refused (typed, per-index) while their
+    # siblings read batch_ok. ---
+    batch = None
+    if os.environ.get("SLU_BATCH_COALESCE") == "1":
+        from superlu_dist_tpu.options import YesNo
+        print("# batch-coalescer scenario: cold-key bursts ...",
+              file=sys.stderr)
+        bopts = Options(factor_dtype="float64",
+                        replace_tiny_pivot=YesNo.NO)
+        bn = max(32, requests // 2)
+        mm = svc.metrics
+        ctr0 = {c: mm.counter(c) for c in
+                ("serve.batch_coalesce_submits", "serve.batch_flushes",
+                 "serve.batch_fanned_back", "serve.batch_member_refused")}
+        breport = run_load(svc, [a], requests=bn,
+                           concurrency=concurrency, hot_fraction=1.0,
+                           seed=2, batch_fraction=0.5,
+                           batch_singular_fraction=0.1,
+                           batch_options=bopts)
+        batch = {
+            "requests": bn,
+            "by_status": breport["by_status"],
+            "coalesce_submits":
+                mm.counter("serve.batch_coalesce_submits")
+                - ctr0["serve.batch_coalesce_submits"],
+            "flushes": mm.counter("serve.batch_flushes")
+            - ctr0["serve.batch_flushes"],
+            "fanned_back": mm.counter("serve.batch_fanned_back")
+            - ctr0["serve.batch_fanned_back"],
+            "member_refused":
+                mm.counter("serve.batch_member_refused")
+                - ctr0["serve.batch_member_refused"],
+        }
+
     obs_dump = svc.dump_metrics_text()
     svc.close()
 
@@ -194,6 +235,7 @@ def run(argv=()):
         "jit_cache_before": jit_before,
         "jit_cache_after": jit_after,
         "mixed_dtype": mixed,
+        "batch_coalesce": batch,
         "recompiles_under_load": misses_after - misses_before,
         "jit_cache_growth": (jit_after - jit_before
                              if jit_before >= 0 else None),

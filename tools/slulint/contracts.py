@@ -139,6 +139,7 @@ CONTRACT_MODULES = (
     "superlu_dist_tpu.numerics.gscon",
     "superlu_dist_tpu.parallel.factor_dist",
     "superlu_dist_tpu.autodiff.solve",
+    "superlu_dist_tpu.batch.engine",
 )
 
 

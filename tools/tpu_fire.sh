@@ -18,7 +18,8 @@
 # measurement), BENCH_SWEEP.jsonl (secondary configs),
 # TPU_AB_TAU.jsonl (amalgamation-tau A/B, step 9),
 # PLAN_LATENCY.jsonl + FIRE_OBS_SNAPSHOT.json (step 3e: plan-build
-# walls + the round's merged fleet telemetry view), FIRE_*.log.
+# walls + the round's merged fleet telemetry view), BATCH.jsonl
+# (step 3f: the batched-factorization A/B), FIRE_*.log.
 set -u
 repo=$(cd "$(dirname "$0")/.." && pwd)
 if [ "${SLU_FIRE_DRYRUN:-0}" = "1" ]; then
@@ -183,6 +184,17 @@ with open('$repo/FIRE_OBS_SNAPSHOT.json', 'w') as f:
     json.dump(fleet, f, indent=1, default=repr)
 " >> "$log" 2>&1
 stamp "obs snapshot archived rc=$? -> FIRE_OBS_SNAPSHOT.json"
+
+# 3f. Batched-factorization A/B (ISSUE 20): k same-pattern value sets
+#     through the shared-plan batch engine vs the per-sample arm —
+#     bench.py --batch appends ONE gated record to BATCH.jsonl
+#     (bitwise pin, zero recompiles across the B-ladder, throughput
+#     ratio >= SLU_BATCH_MIN_SPEEDUP at the k=256/n=128 cell) and
+#     FAILS persisting nothing on any miss.  Small systems, no
+#     device-scale work — runs in the dryrun too; the full sentinel
+#     at the end of the plan gates the committed record.
+timeout 1200 python "$repo/bench.py" --batch >> "$log" 2>&1
+stamp "batch A/B rc=$?"
 
 # 4e. Mesh-resident serving A/B (ISSUE 17): one-device vs mesh
 #     replica on the same key set through the batcher bucket ladder —
